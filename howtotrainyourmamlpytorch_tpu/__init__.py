@@ -1,0 +1,14 @@
+"""TPU-native MAML / MAML++ few-shot learning framework.
+
+A brand-new JAX/XLA re-design of the capabilities of
+``AntreasAntoniou/HowToTrainYourMAMLPytorch`` (see SURVEY.md): bi-level
+meta-optimization as one jit-compiled pure function (grad-through-scan inner
+loop, vmap over tasks, mesh-sharded outer step), MAML++'s LSLR / MSL /
+per-step batch-norm, deterministic resumable episodic data, and a
+fault-tolerant experiment runner.
+"""
+
+from .config import MAMLConfig
+
+__version__ = "0.1.0"
+__all__ = ["MAMLConfig"]

@@ -1,0 +1,216 @@
+"""Typed configuration for the TPU-native MAML/MAML++ framework.
+
+Replaces the reference's argparse + JSON-override flag system
+(``/root/reference/utils/parser_utils.py:4-106``) with a single typed dataclass.
+Key properties preserved:
+
+* every key that appears in the reference's argparse defaults *or* only in its
+  JSON experiment configs (``/root/reference/experiment_config/*.json``) is a
+  field here, under the same name, so the reference's config files load as-is;
+* string booleans ("true"/"false") are coerced (parser_utils.py:63-66);
+* ``dataset_path`` is prefixed with ``$DATASET_DIR`` when that env var is set
+  (parser_utils.py:67-69);
+* JSON keys containing ``continue_from`` or ``gpu_to_use`` are ignored on load
+  (parser_utils.py:103), i.e. resume behaviour is controlled by the CLI only;
+* the reference's *dead* keys (parsed/stored but never read by the compute
+  path — see SURVEY.md §5) are accepted and retained for config-file
+  compatibility but do not influence the system, with one documented
+  exception: ``init_inner_loop_learning_rate`` can optionally be honoured via
+  ``use_config_init_inner_lr`` (the reference reads ``task_learning_rate``
+  instead — few_shot_learning_system.py:46-49 — which is a known quirk).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _coerce_bool(value: Any) -> Any:
+    """Reference-compatible string->bool coercion (parser_utils.py:63-66)."""
+    if isinstance(value, str):
+        if value.lower() == "true":
+            return True
+        if value.lower() == "false":
+            return False
+    return value
+
+
+@dataclass
+class MAMLConfig:
+    """The union of the reference's argparse defaults and JSON-only keys."""
+
+    # --- experiment identity / bookkeeping -------------------------------
+    experiment_name: str = "maml_experiment"
+    seed: int = 104
+    train_seed: int = 0
+    val_seed: int = 0
+    continue_from_epoch: str = "latest"  # 'latest' | 'from_scratch' | int
+    max_models_to_save: int = 5
+    total_epochs_before_pause: int = 100
+    evaluate_on_test_set_only: bool = False
+
+    # --- data ------------------------------------------------------------
+    dataset_name: str = "omniglot_dataset"
+    dataset_path: str = "datasets/omniglot_dataset"
+    batch_size: int = 32
+    image_height: int = 28
+    image_width: int = 28
+    image_channels: int = 1
+    num_classes_per_set: int = 20
+    num_samples_per_class: int = 1
+    num_target_samples: int = 15
+    num_evaluation_tasks: int = 600
+    sets_are_pre_split: bool = False
+    load_into_memory: bool = False
+    train_val_test_split: List[float] = field(
+        default_factory=lambda: [0.73982737361, 0.26, 0.13008631319]
+    )
+    indexes_of_folders_indicating_class: List[int] = field(
+        default_factory=lambda: [-2, -3]
+    )
+    reverse_channels: bool = False
+    labels_as_int: bool = False
+    reset_stored_filepaths: bool = False
+    num_dataprovider_workers: int = 4
+    samples_per_iter: int = 1
+
+    # --- model -----------------------------------------------------------
+    num_stages: int = 4
+    cnn_num_filters: int = 64
+    conv_padding: bool = True
+    max_pooling: bool = False
+    norm_layer: str = "batch_norm"  # 'batch_norm' | 'layer_norm'
+    per_step_bn_statistics: bool = False
+    learnable_bn_gamma: bool = True
+    learnable_bn_beta: bool = True
+    enable_inner_loop_optimizable_bn_params: bool = False
+
+    # --- meta-optimization -----------------------------------------------
+    total_epochs: int = 100
+    total_iter_per_epoch: int = 500
+    meta_learning_rate: float = 0.001
+    min_learning_rate: float = 0.00001
+    task_learning_rate: float = 0.1
+    init_inner_loop_learning_rate: float = 0.01  # honoured iff use_config_init_inner_lr
+    number_of_training_steps_per_iter: int = 1
+    number_of_evaluation_steps_per_iter: int = 1
+    second_order: bool = False
+    first_order_to_second_order_epoch: int = -1
+    use_multi_step_loss_optimization: bool = False
+    multi_step_loss_num_epochs: int = 15
+    learnable_per_layer_per_step_inner_loop_learning_rate: bool = False
+
+    # --- TPU-native knobs (new; no reference counterpart) ----------------
+    compute_dtype: str = "float32"  # 'float32' | 'bfloat16' compute precision
+    use_remat: bool = True  # jax.checkpoint the inner step (memory vs FLOPs)
+    num_devices: int = 0  # 0 => use all visible devices for the task mesh
+    use_config_init_inner_lr: bool = False  # fix the task_learning_rate quirk
+    cache_dir: str = ""  # where dataset path-index JSON caches go ('' => experiment dir)
+    prefetch_batches: int = 2  # host->device pipeline depth
+
+    # --- accepted-but-inert reference keys (SURVEY.md §5 "dead keys") ----
+    dropout_rate_value: float = 0.0
+    weight_decay: float = 0.0
+    cnn_blocks_per_stage: int = 1
+    cnn_num_blocks: int = 4
+    learnable_batch_norm_momentum: bool = False
+    minimum_per_task_contribution: float = 0.01
+    evalute_on_test_set_only: bool = False  # reference's typo twin, kept inert
+    meta_opt_bn: bool = False
+    num_of_gpus: int = 1
+    gpu_to_use: int = 0
+    architecture_name: Optional[str] = None
+    name_of_args_json_file: str = "None"
+    reset_stored_paths: bool = False
+
+    # ---------------------------------------------------------------------
+
+    def __post_init__(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _coerce_bool(getattr(self, f.name)))
+        if os.environ.get("DATASET_DIR") and not os.path.isabs(self.dataset_path):
+            # parser_utils.py:67-69 — dataset_path lives under $DATASET_DIR.
+            self.dataset_path = os.path.join(
+                os.environ["DATASET_DIR"], self.dataset_path
+            )
+
+    # -- derived quantities ------------------------------------------------
+
+    @property
+    def im_shape(self) -> Tuple[int, int, int]:
+        """(h, w, c) — NHWC, the TPU-native layout."""
+        return (self.image_height, self.image_width, self.image_channels)
+
+    @property
+    def inner_lr_init(self) -> float:
+        """The inner-loop LR actually used at init.
+
+        The reference initialises LSLR from ``task_learning_rate``
+        (few_shot_learning_system.py:46-51) and never reads the JSON's
+        ``init_inner_loop_learning_rate`` — preserved by default, fixable via
+        ``use_config_init_inner_lr``.
+        """
+        if self.use_config_init_inner_lr:
+            return self.init_inner_loop_learning_rate
+        return self.task_learning_rate
+
+    @property
+    def clip_grads(self) -> bool:
+        """Reference clamps outer grads to ±10 for imagenet datasets
+        (few_shot_learning_system.py:332-335)."""
+        return "imagenet" in self.dataset_name
+
+    @property
+    def bn_num_steps(self) -> int:
+        """Size of the per-step BN arrays.
+
+        The reference sizes them by the *training* step count
+        (meta_neural_network_architectures.py:178-185); we size by the max of
+        train/eval step counts so eval with more steps than train cannot index
+        out of bounds (SURVEY.md §7 hazard), and clamp at apply time.
+        """
+        return max(
+            self.number_of_training_steps_per_iter,
+            self.number_of_evaluation_steps_per_iter,
+        )
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def known_keys(cls) -> set:
+        return {f.name for f in dataclasses.fields(cls)}
+
+    @classmethod
+    def from_json_file(cls, path: str, **overrides: Any) -> "MAMLConfig":
+        """Load a reference-style experiment JSON, with keyword overrides.
+
+        Mirrors ``extract_args_from_json`` (parser_utils.py:96-106): every key
+        in the file overrides the defaults, except ``continue_from*`` and
+        ``gpu_to_use`` which are resume/device controls owned by the caller.
+        Unknown keys are ignored with a warning (the reference would silently
+        carry them on the args object).
+        """
+        with open(path) as f:
+            raw = json.load(f)
+        kwargs: Dict[str, Any] = {}
+        known = cls.known_keys()
+        for key, value in raw.items():
+            if "continue_from" in key or "gpu_to_use" in key:
+                continue
+            if key not in known:
+                print(f"[config] ignoring unknown key {key!r} from {path}")
+                continue
+            kwargs[key] = value
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def to_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2, sort_keys=True)
+
+    def replace(self, **changes: Any) -> "MAMLConfig":
+        return dataclasses.replace(self, **changes)

@@ -1,0 +1,1 @@
+from . import lslr, maml, msl, partition

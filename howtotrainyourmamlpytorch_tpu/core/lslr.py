@@ -1,0 +1,42 @@
+"""LSLR — per-parameter, per-step learnable inner-loop learning rates.
+
+Reference: ``LSLRGradientDescentLearningRule``
+(inner_loop_optimizers.py:55-113). One learning-rate vector of shape
+``(num_inner_steps + 1,)`` per inner-adapted parameter tensor, initialised to
+the task learning rate, meta-learned by the outer optimizer when
+``learnable_per_layer_per_step_inner_loop_learning_rate``.
+
+Here the whole thing is just a pytree mirroring the adapted-parameter dict —
+the update is ``theta - lr[name][step] * grad`` (inner_loop_optimizers.py:
+108-113), applied inside the scanned inner step. The ``+1``-th entry is never
+indexed (steps run 0..N-1), faithfully preserving the reference's shape.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+LSLRParams = Dict[str, jnp.ndarray]
+
+
+def init(adapted_param_names, num_inner_steps: int, init_learning_rate: float) -> LSLRParams:
+    """One (num_inner_steps + 1,) LR vector per adapted parameter
+    (inner_loop_optimizers.py:86-91)."""
+    return {
+        name: jnp.full((num_inner_steps + 1,), init_learning_rate, jnp.float32)
+        for name in adapted_param_names
+    }
+
+
+def update_params(
+    weights: Dict[str, jnp.ndarray],
+    grads: Dict[str, jnp.ndarray],
+    lslr: LSLRParams,
+    num_step,
+) -> Dict[str, jnp.ndarray]:
+    """theta' = theta - lr[name][step] * g (inner_loop_optimizers.py:108-113)."""
+    return {
+        key: weights[key] - lslr[key][num_step] * grads[key] for key in weights
+    }

@@ -1,0 +1,57 @@
+"""Multi-Step Loss (MSL) importance schedule — MAML++'s per-step loss weights.
+
+Pure re-implementation of ``get_per_step_loss_importance_vector``
+(few_shot_learning_system.py:83-103): starts uniform ``1/N`` over the N inner
+steps, anneals the non-final weights down by ``epoch / N / anneal_epochs``
+each epoch (floored at ``0.03/N``) while the final step's weight absorbs the
+difference (capped at ``1 - (N-1) * 0.03/N``).
+
+The reference gates MSL on ``training and epoch < multi_step_loss_num_epochs``
+(few_shot_learning_system.py:232) and otherwise uses only the final step's
+target loss (:239-244). ``loss_weights_for`` folds that gate in by returning a
+one-hot-on-last-step vector when MSL is inactive, so a single weighted-sum
+formulation covers both branches with identical numerics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def per_step_loss_importance(
+    num_steps: int, multi_step_loss_num_epochs: int, epoch: int
+) -> np.ndarray:
+    """The annealed per-step weights at a given (integer) epoch."""
+    loss_weights = np.ones(num_steps, dtype=np.float32) / num_steps
+    decay_rate = 1.0 / num_steps / multi_step_loss_num_epochs
+    min_non_final = 0.03 / num_steps
+    for i in range(num_steps - 1):
+        loss_weights[i] = np.maximum(
+            loss_weights[i] - epoch * decay_rate, min_non_final
+        )
+    loss_weights[-1] = np.minimum(
+        loss_weights[-1] + epoch * (num_steps - 1) * decay_rate,
+        1.0 - (num_steps - 1) * min_non_final,
+    )
+    return loss_weights
+
+
+def final_step_only(num_steps: int) -> np.ndarray:
+    """One-hot on the last step: the non-MSL / post-anneal / eval branch
+    (few_shot_learning_system.py:239-244)."""
+    w = np.zeros(num_steps, dtype=np.float32)
+    w[-1] = 1.0
+    return w
+
+
+def loss_weights_for(
+    num_steps: int,
+    use_msl: bool,
+    training: bool,
+    epoch: int,
+    multi_step_loss_num_epochs: int,
+) -> np.ndarray:
+    """The weight vector for a given phase/epoch, gate included."""
+    if use_msl and training and epoch < multi_step_loss_num_epochs:
+        return per_step_loss_importance(num_steps, multi_step_loss_num_epochs, epoch)
+    return final_step_only(num_steps)

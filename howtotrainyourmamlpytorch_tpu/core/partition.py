@@ -1,0 +1,75 @@
+"""Parameter partitioning: inner-loop-adapted vs frozen, trainable vs not.
+
+Replaces the reference's name-string filtering
+(``get_inner_loop_parameter_dict`` few_shot_learning_system.py:105-120: all
+``requires_grad`` params except those whose name contains ``norm_layer``) and
+its ``requires_grad`` bookkeeping scattered across module definitions
+(meta_neural_network_architectures.py:177-198,279) with two pure predicates
+over flat parameter names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..config import MAMLConfig
+
+Params = Dict[str, jnp.ndarray]
+
+
+def is_norm_param(name: str) -> bool:
+    return ".norm." in name
+
+
+def is_inner_adapted(cfg: MAMLConfig, name: str) -> bool:
+    """Whether a parameter is updated by the inner loop.
+
+    Reference: norm params are excluded unless
+    ``enable_inner_loop_optimizable_bn_params``
+    (few_shot_learning_system.py:115-119). Layer-norm gamma is frozen
+    (requires_grad=False, meta_...py:279) so it is never adapted even with the
+    enable flag — the reference's inner dict filters on requires_grad.
+    """
+    if not is_norm_param(name):
+        return True
+    if not cfg.enable_inner_loop_optimizable_bn_params:
+        return False
+    if cfg.norm_layer == "layer_norm" and name.endswith(".gamma"):
+        return False
+    return True
+
+
+def is_trainable(cfg: MAMLConfig, name: str) -> bool:
+    """Whether the outer (Adam) optimizer updates a parameter.
+
+    Mirrors the reference's requires_grad flags: BN gamma/beta trainability
+    from ``learnable_bn_gamma``/``learnable_bn_beta`` (meta_...py:182-192);
+    layer-norm gamma frozen (:279); conv/linear always trainable.
+    """
+    if not is_norm_param(name):
+        return True
+    if name.endswith(".gamma"):
+        if cfg.norm_layer == "layer_norm":
+            return False
+        return cfg.learnable_bn_gamma
+    if name.endswith(".beta"):
+        if cfg.norm_layer == "layer_norm":
+            return True
+        return cfg.learnable_bn_beta
+    return True
+
+
+def split_inner(cfg: MAMLConfig, params: Params) -> Tuple[Params, Params]:
+    """Partition net params into (adapted, frozen) flat dicts."""
+    adapted = {k: v for k, v in params.items() if is_inner_adapted(cfg, k)}
+    frozen = {k: v for k, v in params.items() if not is_inner_adapted(cfg, k)}
+    return adapted, frozen
+
+
+def trainable_labels(cfg: MAMLConfig, params: Params) -> Dict[str, str]:
+    """'train'/'freeze' labels for optax.multi_transform over net params."""
+    return {
+        k: ("train" if is_trainable(cfg, k) else "freeze") for k in params
+    }

@@ -1,0 +1,201 @@
+"""The few-shot backbone: ``num_stages`` conv blocks + linear head, functional.
+
+TPU-native re-design of the reference's ``VGGReLUNormNetwork``
+(meta_neural_network_architectures.py:545-689) and its block
+(``MetaConvNormLayerReLU`` :323-436):
+
+* parameters are a flat ``{name: array}`` pytree — the reference's entire
+  external-weight routing machinery (``extract_top_level_dict``
+  meta_...py:11-38, per-layer params switches) dissolves into ordinary
+  function arguments;
+* activations are NHWC, kernels HWIO (MXU-friendly), vs the reference's NCHW;
+* batch-norm running statistics are explicit state in/out rather than module
+  mutation, so the reference's backup/restore dance
+  (meta_...py:200-201,240-255) becomes "discard the returned state at eval";
+* the architecture itself is identical: per stage a 3x3 conv (stride 1 +
+  2x2 maxpool when ``max_pooling``, stride 2 otherwise — meta_...py:568-573),
+  norm, leaky-relu; global avg-pool when not max-pooling (:608-609); flatten;
+  linear head (:614-615).
+
+Per-step batch-norm (MAML++ BNWB/BNRS, meta_...py:177-185,226-234): when
+``per_step_bn_statistics``, gamma/beta and running mean/var have a leading
+inner-step axis and are indexed by the current inner step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MAMLConfig
+from ..ops import functional as F
+
+Params = Dict[str, jnp.ndarray]
+BNState = Dict[str, jnp.ndarray]
+
+
+def _xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    """torch.nn.init.xavier_uniform_ (gain=1), as used for conv and linear
+    weights (meta_...py:64,117)."""
+    a = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+def _feature_hw(cfg: MAMLConfig) -> Tuple[int, int]:
+    """Spatial size after the conv stack (shape inference, replacing the
+    reference's dummy-tensor trace meta_...py:581-618)."""
+    h, w = cfg.image_height, cfg.image_width
+    pad = 1 if cfg.conv_padding else 0
+    for _ in range(cfg.num_stages):
+        if cfg.max_pooling:
+            # stride-1 conv then 2x2/2 maxpool (meta_...py:570,604-605)
+            h = h + 2 * pad - 2
+            w = w + 2 * pad - 2
+            h, w = h // 2, w // 2
+        else:
+            # stride-2 conv (meta_...py:573)
+            h = (h + 2 * pad - 3) // 2 + 1
+            w = (w + 2 * pad - 3) // 2 + 1
+    return h, w
+
+
+def feature_dim(cfg: MAMLConfig) -> int:
+    """Flattened feature dim entering the linear head."""
+    if cfg.max_pooling:
+        h, w = _feature_hw(cfg)
+        return h * w * cfg.cnn_num_filters
+    # global avg pool -> 1x1xC (meta_...py:608-612)
+    return cfg.cnn_num_filters
+
+
+def init(cfg: MAMLConfig, key: jax.Array) -> Tuple[Params, BNState]:
+    """Build the parameter and BN-state pytrees.
+
+    Naming: ``conv{i}.conv.{weight,bias}``, ``conv{i}.norm.{gamma,beta}``,
+    ``linear.{weight,bias}`` — flat keys, one array per leaf. BN state:
+    ``conv{i}.norm.{mean,var}``.
+    """
+    params: Params = {}
+    bn_state: BNState = {}
+    steps = cfg.bn_num_steps
+    c_in = cfg.image_channels
+    f = cfg.cnn_num_filters
+    keys = jax.random.split(key, cfg.num_stages + 1)
+
+    ln_h, ln_w = cfg.image_height, cfg.image_width
+    pad = 1 if cfg.conv_padding else 0
+    for i in range(cfg.num_stages):
+        params[f"conv{i}.conv.weight"] = _xavier_uniform(
+            keys[i], (3, 3, c_in, f), fan_in=c_in * 9, fan_out=f * 9
+        )
+        params[f"conv{i}.conv.bias"] = jnp.zeros((f,))
+        if cfg.norm_layer == "batch_norm":
+            if cfg.per_step_bn_statistics and not cfg.enable_inner_loop_optimizable_bn_params:
+                # per-step gamma/beta (meta_...py:182-185)
+                params[f"conv{i}.norm.gamma"] = jnp.ones((steps, f))
+                params[f"conv{i}.norm.beta"] = jnp.zeros((steps, f))
+            else:
+                # plain or inner-loop-adaptable scalars-per-feature
+                # (meta_...py:187-198)
+                params[f"conv{i}.norm.gamma"] = jnp.ones((f,))
+                params[f"conv{i}.norm.beta"] = jnp.zeros((f,))
+            if cfg.per_step_bn_statistics:
+                bn_state[f"conv{i}.norm.mean"] = jnp.zeros((steps, f))
+                bn_state[f"conv{i}.norm.var"] = jnp.ones((steps, f))
+        elif cfg.norm_layer == "layer_norm":
+            # normalized over the full (h, w, c) post-conv feature shape
+            # (meta_...py:379: input_feature_shape=out.shape[1:])
+            if cfg.max_pooling:
+                ln_h, ln_w = ln_h + 2 * pad - 2, ln_w + 2 * pad - 2
+            else:
+                ln_h = (ln_h + 2 * pad - 3) // 2 + 1
+                ln_w = (ln_w + 2 * pad - 3) // 2 + 1
+            params[f"conv{i}.norm.gamma"] = jnp.ones((ln_h, ln_w, f))
+            params[f"conv{i}.norm.beta"] = jnp.zeros((ln_h, ln_w, f))
+            if cfg.max_pooling:
+                ln_h, ln_w = ln_h // 2, ln_w // 2
+        else:
+            raise ValueError(f"unknown norm_layer {cfg.norm_layer!r}")
+        c_in = f
+
+    feat = feature_dim(cfg)
+    params["linear.weight"] = _xavier_uniform(
+        keys[-1], (feat, cfg.num_classes_per_set), fan_in=feat,
+        fan_out=cfg.num_classes_per_set,
+    )
+    params["linear.bias"] = jnp.zeros((cfg.num_classes_per_set,))
+    return params, bn_state
+
+
+def apply(
+    cfg: MAMLConfig,
+    params: Params,
+    bn_state: BNState,
+    x: jnp.ndarray,
+    num_step,
+    training: bool = True,
+) -> Tuple[jnp.ndarray, BNState]:
+    """Forward pass.
+
+    :param x: (batch, h, w, c) images, NHWC.
+    :param num_step: current inner-loop step (traced scalar ok) — indexes the
+        per-step BN params/stats (meta_...py:226-234). Clamped to the stored
+        step count so eval with more steps than train stays in bounds
+        (SURVEY.md §7 hazard; the reference would index out of bounds).
+    :param training: only affects whether updated BN running stats are
+        *returned*; normalization always uses batch stats, exactly like the
+        reference's ``training=True`` call (meta_...py:246-247).
+    :return: (logits (batch, way), new_bn_state).
+    """
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    out = x.astype(dtype)
+    stride = 1 if cfg.max_pooling else 2
+    pad = 1 if cfg.conv_padding else 0
+    new_bn: BNState = {}
+    step = jnp.clip(num_step, 0, cfg.bn_num_steps - 1)
+
+    for i in range(cfg.num_stages):
+        out = F.conv2d(
+            out,
+            params[f"conv{i}.conv.weight"],
+            params[f"conv{i}.conv.bias"],
+            stride=stride,
+            padding=pad,
+        )
+        gamma = params[f"conv{i}.norm.gamma"]
+        beta = params[f"conv{i}.norm.beta"]
+        if cfg.norm_layer == "batch_norm":
+            if gamma.ndim == 2:  # per-step (steps, f)
+                gamma = gamma[step]
+                beta = beta[step]
+            mean_key, var_key = f"conv{i}.norm.mean", f"conv{i}.norm.var"
+            if mean_key in bn_state:
+                rm, rv = bn_state[mean_key][step], bn_state[var_key][step]
+                out, nm, nv = F.batch_norm(out, gamma, beta, rm, rv)
+                if training:
+                    new_bn[mean_key] = bn_state[mean_key].at[step].set(nm)
+                    new_bn[var_key] = bn_state[var_key].at[step].set(nv)
+                else:
+                    new_bn[mean_key] = bn_state[mean_key]
+                    new_bn[var_key] = bn_state[var_key]
+            else:
+                out, _, _ = F.batch_norm(out, gamma, beta, None, None)
+        else:
+            out = F.layer_norm(out, gamma, beta)
+        out = F.leaky_relu(out)
+        if cfg.max_pooling:
+            out = F.max_pool2d(out)
+
+    if not cfg.max_pooling:
+        out = F.global_avg_pool2d(out)
+    out = out.reshape(out.shape[0], -1)
+    logits = F.linear(out, params["linear.weight"], params["linear.bias"])
+    return logits.astype(jnp.float32), new_bn
+
+
+def num_params(params: Params) -> int:
+    return int(sum(np.prod(v.shape) for v in params.values()))

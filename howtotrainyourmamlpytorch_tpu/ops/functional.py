@@ -1,0 +1,155 @@
+"""Pure-functional NN ops, NHWC/TPU-native.
+
+These are the JAX/XLA equivalents of the torch functional calls the reference
+makes (``F.conv2d`` meta_neural_network_architectures.py:89, ``F.linear`` :141,
+``F.batch_norm`` :246, ``F.layer_norm`` :314, ``F.max_pool2d``/``F.avg_pool2d``
+:605/:609, ``F.leaky_relu`` :383, ``F.cross_entropy``
+few_shot_learning_system.py:284) — but re-designed for the MXU:
+
+* NHWC activations + HWIO kernels (the layout XLA tiles best on TPU);
+* optional bfloat16 compute with float32 parameter master copies;
+* batch-norm always normalizes with *batch* statistics, faithfully mirroring
+  the reference's ``training=True`` call (meta_...py:246-247) — running stats
+  are tracked as explicit state, never used for normalization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Dimension numbers for NHWC activations with HWIO kernels.
+CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    b: Optional[jnp.ndarray],
+    stride: int,
+    padding: int,
+) -> jnp.ndarray:
+    """2-D convolution, NHWC x HWIO -> NHWC (ref: F.conv2d, meta_...py:89-97).
+
+    ``padding`` is symmetric integer padding like torch's ``padding=`` int.
+    """
+    out = lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=CONV_DIMS,
+    )
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Dense layer x @ w + b with w of shape (in, out) (ref: F.linear :141)."""
+    out = x @ w.astype(x.dtype)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def max_pool2d(x: jnp.ndarray, window: int = 2, stride: int = 2) -> jnp.ndarray:
+    """2x2 max pool, NHWC (ref: F.max_pool2d, meta_...py:605,652)."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+
+
+def global_avg_pool2d(x: jnp.ndarray) -> jnp.ndarray:
+    """Global average pool over H,W (ref: F.avg_pool2d(out, out.shape[2]),
+    meta_...py:609,655 — window == feature map, i.e. global)."""
+    return jnp.mean(x, axis=(1, 2), keepdims=True)
+
+
+def leaky_relu(x: jnp.ndarray, negative_slope: float = 0.01) -> jnp.ndarray:
+    """Leaky ReLU with torch's default slope (ref: F.leaky_relu :383,426)."""
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+def batch_norm(
+    x: jnp.ndarray,
+    gamma: jnp.ndarray,
+    beta: jnp.ndarray,
+    running_mean: Optional[jnp.ndarray],
+    running_var: Optional[jnp.ndarray],
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """Batch norm over (N, H, W) per channel, NHWC.
+
+    Faithful to the reference's ``F.batch_norm(..., training=True)``
+    (meta_...py:246-247): normalization ALWAYS uses the current batch's
+    statistics; the running stats are updated (torch momentum convention:
+    ``new = (1 - m) * old + m * batch``, with the *unbiased* batch variance
+    feeding the running var) but never normalize anything.
+
+    Returns (y, new_running_mean, new_running_var); the stats are None-in
+    None-out so batch-norm-without-tracking is the same code path.
+    """
+    reduce_axes = tuple(range(x.ndim - 1))  # all but channel
+    mean = jnp.mean(x, axis=reduce_axes)
+    var = jnp.var(x, axis=reduce_axes)
+    inv = lax.rsqrt(var + eps).astype(x.dtype)
+    y = (x - mean.astype(x.dtype)) * inv
+    y = y * gamma.astype(x.dtype) + beta.astype(x.dtype)
+
+    new_mean = new_var = None
+    if running_mean is not None:
+        n = 1
+        for ax in reduce_axes:
+            n *= x.shape[ax]
+        unbiased = var * (n / max(n - 1, 1))
+        new_mean = (1.0 - momentum) * running_mean + momentum * mean.astype(
+            running_mean.dtype
+        )
+        new_var = (1.0 - momentum) * running_var + momentum * unbiased.astype(
+            running_var.dtype
+        )
+    return y, new_mean, new_var
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    gamma: jnp.ndarray,
+    beta: jnp.ndarray,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    """Layer norm over the trailing feature dims (ref: F.layer_norm :314-315).
+
+    The reference normalizes over the full per-sample feature shape (c, h, w)
+    with affine params of that same shape; here NHWC (h, w, c). The gamma the
+    reference uses is frozen at 1 (meta_...py:279) — enforced by the caller's
+    trainability partition, not here.
+    """
+    reduce_axes = tuple(range(1, x.ndim))
+    mean = jnp.mean(x, axis=reduce_axes, keepdims=True)
+    var = jnp.var(x, axis=reduce_axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    return y * gamma.astype(x.dtype) + beta.astype(x.dtype)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels (ref: F.cross_entropy,
+    few_shot_learning_system.py:284). Computed in float32 for stability."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample correctness, float (ref: few_shot_learning_system.py:247-249)."""
+    return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
