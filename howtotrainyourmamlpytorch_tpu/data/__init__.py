@@ -1,0 +1,2 @@
+from . import datasets, episodes, loader
+from .loader import FewShotEpisodicDataset, MetaLearningDataLoader

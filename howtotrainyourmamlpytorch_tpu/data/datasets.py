@@ -1,0 +1,176 @@
+"""Dataset indexing, caching, splitting, and in-RAM preloading.
+
+Host-side re-implementation of the reference's dataset management
+(``FewShotLearningDatasetParallel`` data.py:111-552, minus task sampling
+which lives in ``episodes.py``):
+
+* directory walk -> class->filepath index with corrupt-image screening,
+  cached as JSON (data.py:302-328, 234-267). The cache is written to a
+  configurable ``cache_dir`` instead of ``$DATASET_DIR`` (the reference
+  writes next to the dataset — data.py:247-250 — which breaks on read-only
+  dataset mounts);
+* class splits: pre-split directory layout (train/val/test dirs,
+  data.py:178-189) or ratio split over val-seed-shuffled classes
+  (data.py:190-211);
+* optional full in-RAM preload with a worker pool (data.py:213-230; the
+  reference forks a process pool — we use threads, which JAX requires and
+  which PIL's GIL-releasing decode parallelizes fine) —
+  mandatory for TPU-rate training, where per-episode PIL decoding would
+  starve the device (SURVEY.md §7).
+
+Seed discipline is replicated exactly (data.py:132-142): the working seeds
+are drawn via ``RandomState(seed).randint(1, 999999)`` and the *test* stream
+shares the val seed, so test tasks equal val-sampling with the same stream
+(a reference property the eval protocol depends on).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..config import MAMLConfig
+from .episodes import load_image
+
+ClassIndex = Dict[str, List[str]]  # class key -> image file paths
+
+
+def draw_stream_seeds(cfg: MAMLConfig) -> Dict[str, int]:
+    """Initial per-set task-stream seeds (data.py:132-142).
+
+    test deliberately shares val's seed — the reference builds ``init_seed``
+    with ``args.val_seed`` for both 'val' and 'test' (data.py:141-142).
+    """
+    val_seed = int(np.random.RandomState(cfg.val_seed).randint(1, 999999))
+    train_seed = int(np.random.RandomState(cfg.train_seed).randint(1, 999999))
+    return {"train": train_seed, "val": val_seed, "test": val_seed}
+
+
+def _cache_paths(cfg: MAMLConfig, cache_dir: str) -> Tuple[str, str, str]:
+    os.makedirs(cache_dir, exist_ok=True)
+    return (
+        os.path.join(cache_dir, f"{cfg.dataset_name}.json"),
+        os.path.join(cache_dir, f"map_to_label_name_{cfg.dataset_name}.json"),
+        os.path.join(cache_dir, f"label_name_to_map_{cfg.dataset_name}.json"),
+    )
+
+
+def _label_from_path(cfg: MAMLConfig, filepath: str):
+    """Class label from folder structure (data.py:363-372)."""
+    bits = filepath.split("/")
+    label = "/".join(bits[idx] for idx in cfg.indexes_of_folders_indicating_class)
+    return int(label) if cfg.labels_as_int else label
+
+
+def _screen_image(filepath: str):
+    """Corrupt-image check (data.py:280-300): openable -> keep."""
+    from PIL import Image
+
+    try:
+        Image.open(filepath)
+        return filepath
+    except Exception:
+        return None
+
+
+def scan_dataset(cfg: MAMLConfig) -> Tuple[Dict[str, List[str]], Dict, Dict]:
+    """Walk the dataset dir and build the class index (data.py:302-335)."""
+    raw_paths: List[str] = []
+    labels = set()
+    for subdir, _, files in os.walk(cfg.dataset_path):
+        for file in files:
+            if file.lower().endswith((".jpeg", ".png", ".jpg")):
+                filepath = os.path.abspath(os.path.join(subdir, file))
+                raw_paths.append(filepath)
+                labels.add(_label_from_path(cfg, filepath))
+    labels = sorted(labels)
+    idx_to_label = {idx: label for idx, label in enumerate(labels)}
+    label_to_idx = {label: idx for idx, label in enumerate(labels)}
+    index: Dict[str, List[str]] = {str(idx): [] for idx in idx_to_label}
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        for ok in ex.map(_screen_image, raw_paths, chunksize=256):
+            if ok is not None:
+                index[str(label_to_idx[_label_from_path(cfg, ok)])].append(ok)
+    return index, idx_to_label, label_to_idx
+
+
+def load_class_index(cfg: MAMLConfig, cache_dir: str):
+    """JSON-cached class index (data.py:234-267), cache under ``cache_dir``."""
+    index_file, i2l_file, l2i_file = _cache_paths(cfg, cache_dir)
+    if cfg.reset_stored_filepaths and os.path.exists(index_file):
+        os.remove(index_file)
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            index = json.load(f)
+        with open(i2l_file) as f:
+            idx_to_label = {int(k): v for k, v in json.load(f).items()}
+        with open(l2i_file) as f:
+            label_to_idx = json.load(f)
+        return index, idx_to_label, label_to_idx
+    index, idx_to_label, label_to_idx = scan_dataset(cfg)
+    with open(index_file, "w") as f:
+        json.dump(index, f)
+    with open(i2l_file, "w") as f:
+        json.dump(idx_to_label, f)
+    with open(l2i_file, "w") as f:
+        json.dump({str(k): v for k, v in label_to_idx.items()}, f)
+    return index, idx_to_label, label_to_idx
+
+
+def split_classes(
+    cfg: MAMLConfig,
+    index: ClassIndex,
+    idx_to_label: Dict[int, str],
+    val_stream_seed: int,
+) -> Dict[str, ClassIndex]:
+    """Train/val/test class partition (data.py:169-211).
+
+    Pre-split mode: the first path component of the label names the set
+    (data.py:178-189). Ratio mode: classes shuffled with the *drawn* val seed
+    then cut at the cumulative split fractions (data.py:190-211) — preserving
+    class order exactly so task streams match the reference's.
+    """
+    if cfg.sets_are_pre_split:
+        splits: Dict[str, ClassIndex] = {}
+        for key, paths in index.items():
+            label = idx_to_label[int(key)]
+            set_name, class_label = label.split("/")[0], label.split("/")[1]
+            splits.setdefault(set_name, {})[class_label] = paths
+        return splits
+    rng = np.random.RandomState(seed=val_stream_seed)
+    keys = list(index.keys())
+    order = np.arange(len(keys), dtype=np.int32)
+    rng.shuffle(order)
+    keys = [keys[i] for i in order]
+    total = len(keys)
+    n_train = int(cfg.train_val_test_split[0] * total)
+    n_val = int(sum(cfg.train_val_test_split[:2]) * total)
+    return {
+        "train": {k: index[k] for k in keys[:n_train]},
+        "val": {k: index[k] for k in keys[n_train:n_val]},
+        "test": {k: index[k] for k in keys[n_val:]},
+    }
+
+
+def _load_class(args) -> Tuple[str, np.ndarray]:
+    cfg, class_key, paths = args
+    images = np.stack([load_image(cfg, p) for p in paths]).astype(np.float32)
+    return class_key, images
+
+
+def preload_to_memory(
+    cfg: MAMLConfig, splits: Dict[str, ClassIndex]
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Decode every image once into float32 arrays (data.py:213-230)."""
+    loaded: Dict[str, Dict[str, np.ndarray]] = {}
+    for set_name, classes in splits.items():
+        loaded[set_name] = {}
+        jobs = [(cfg, k, v) for k, v in classes.items()]
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            for class_key, images in ex.map(_load_class, jobs):
+                loaded[set_name][class_key] = images
+    return loaded

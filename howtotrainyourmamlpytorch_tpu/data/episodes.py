@@ -1,0 +1,154 @@
+"""Episode (task) sampling with the reference's exact RNG discipline.
+
+Re-implementation of ``FewShotLearningDatasetParallel.get_set``
+(data.py:478-524) plus image loading (:374-395) and augmentation (:17-108),
+producing NHWC numpy arrays ready for the device.
+
+RNG sequence per task, bit-for-bit the reference's
+(``np.random.RandomState(seed)``):
+
+1. ``choice(class_keys, num_classes_per_set, replace=False)``  (:486-488)
+2. ``shuffle(selected_classes)``                                (:488)
+3. ``randint(0, 4, num_classes_per_set)`` rotation k per class  (:489-490)
+4. per class: ``choice(class_size, spc + targets, replace=False)`` (:499-500)
+
+Faithful quirks preserved:
+* Omniglot pixels are float32 in [0, 255] — ``load_image`` resizes with
+  LANCZOS and does NOT rescale (data.py:383-387), and torchvision's ToTensor
+  doesn't rescale float arrays;
+* ImageNet-family images are /255 then ImageNet-stat normalized regardless of
+  the augment flag (data.py:98-106);
+* the rotation k is always drawn (advancing the stream) but only applied for
+  train-time Omniglot (augment flag, experiment_builder.py:60).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple, Union
+
+import numpy as np
+
+from ..config import MAMLConfig
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class Episode(NamedTuple):
+    """One few-shot task, NHWC. Shapes: x (n_way, k, h, w, c), y (n_way, k)."""
+
+    x_support: np.ndarray
+    x_target: np.ndarray
+    y_support: np.ndarray
+    y_target: np.ndarray
+    seed: int
+
+
+def load_image(cfg: MAMLConfig, image_path: str) -> np.ndarray:
+    """Decode one image to float32 HWC (data.py:374-395).
+
+    Omniglot: LANCZOS resize, values left in [0, 255] (reference quirk).
+    Others: bilinear resize, RGB, /255.
+    """
+    from PIL import Image
+
+    image = Image.open(image_path)
+    if "omniglot" in cfg.dataset_name:
+        image = image.resize(
+            (cfg.image_height, cfg.image_width), resample=Image.LANCZOS
+        )
+        arr = np.array(image, np.float32)
+        if cfg.image_channels == 1 and arr.ndim == 2:
+            arr = arr[:, :, None]
+    else:
+        image = image.resize((cfg.image_height, cfg.image_width)).convert("RGB")
+        arr = np.array(image, np.float32) / 255.0
+    return arr
+
+
+def augment_image(
+    cfg: MAMLConfig,
+    image: np.ndarray,
+    k: int,
+    augment: bool,
+    rng: np.random.RandomState = None,
+) -> np.ndarray:
+    """Per-image transform pipeline (data.py:55-108), HWC in/out.
+
+    Omniglot train: rot90 by k (class-wise). ImageNet family: ImageNet-stat
+    normalize (train == eval). CIFAR family: random crop + horizontal flip at
+    train time, then mean/std normalize — the reference uses torchvision's
+    global RNG for these; we use the episode RNG so tasks stay deterministic.
+    """
+    name = cfg.dataset_name
+    if "omniglot" in name:
+        if augment:
+            image = np.rot90(image, k=k).copy()
+        return image
+    if "imagenet" in name:
+        return (image - IMAGENET_MEAN) / IMAGENET_STD
+    if "cifar" in name:
+        if augment and rng is not None:
+            padded = np.pad(image, ((4, 4), (4, 4), (0, 0)), mode="constant")
+            top = rng.randint(0, 9)
+            left = rng.randint(0, 9)
+            image = padded[top : top + 32, left : left + 32]
+            if rng.randint(0, 2):
+                image = image[:, ::-1].copy()
+        mean = np.asarray(getattr(cfg, "classification_mean", 0.5), np.float32)
+        std = np.asarray(getattr(cfg, "classification_std", 0.5), np.float32)
+        return (image - mean) / std
+    return image
+
+
+InMemoryClass = np.ndarray  # (num_images, h, w, c)
+ClassStore = Dict[str, Union[list, InMemoryClass]]  # paths or decoded arrays
+
+
+def sample_episode(
+    cfg: MAMLConfig,
+    classes: ClassStore,
+    class_keys: np.ndarray,
+    seed: int,
+    augment: bool,
+) -> Episode:
+    """Draw one task (data.py:478-524).
+
+    :param classes: class key -> image paths (lazy decode) or a pre-decoded
+        (n, h, w, c) array (the in-RAM path, data.py:405-410).
+    :param class_keys: the class key list in the reference's ordering —
+        MUST match the reference's dict insertion order for stream parity.
+    """
+    rng = np.random.RandomState(seed)
+    selected = rng.choice(class_keys, size=cfg.num_classes_per_set, replace=False)
+    rng.shuffle(selected)
+    k_list = rng.randint(0, 4, size=cfg.num_classes_per_set)
+
+    spc, nts = cfg.num_samples_per_class, cfg.num_target_samples
+    x_images = []
+    y_labels = []
+    for episode_label, class_key in enumerate(selected):
+        store = classes[class_key]
+        sample_idx = rng.choice(len(store), size=spc + nts, replace=False)
+        imgs = []
+        for si in sample_idx:
+            if isinstance(store, np.ndarray):
+                img = store[si]
+            else:
+                img = load_image(cfg, store[si])
+            imgs.append(
+                augment_image(cfg, img, k=int(k_list[episode_label]),
+                              augment=augment, rng=rng)
+            )
+        x_images.append(np.stack(imgs))
+        y_labels.append(np.full(spc + nts, episode_label, np.int32))
+
+    x = np.stack(x_images).astype(np.float32)  # (n, spc+nts, h, w, c)
+    y = np.stack(y_labels)
+    return Episode(
+        x_support=x[:, :spc],
+        x_target=x[:, spc:],
+        y_support=y[:, :spc],
+        y_target=y[:, spc:],
+        seed=seed,
+    )

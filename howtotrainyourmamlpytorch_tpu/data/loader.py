@@ -1,0 +1,163 @@
+"""Batched, prefetching, resume-exact episodic data loader.
+
+Replaces the reference's torch ``DataLoader`` wrapper
+(``MetaLearningSystemDataLoader`` data.py:555-637) with a thread-pool episode
+builder + bounded prefetch queue feeding numpy batches:
+
+* batch = ``num_devices * batch_size * samples_per_iter`` tasks stacked on a
+  leading task axis (data.py:580) — the axis the device mesh shards;
+* task seeds: ``seed[set] + idx`` with idx sequential from 0 per generator
+  (shuffle=False determinism, data.py:544-549,581);
+* resume: ``continue_from_iter`` advances the produced-task counter by
+  ``current_iter * tasks_per_batch`` (data.py:583-588) and every
+  ``get_train_batches`` call advances it by one batch worth (data.py:598-602)
+  — both quirks preserved so a resumed run continues the task stream at
+  exactly the next unseen task, like the reference;
+* val/test streams restart from their fixed seed every call, so validation
+  tasks are identical across epochs and the test stream equals the val stream
+  (data.py:136-142,538-539) — properties the best-val selection and ensemble
+  eval rely on.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..config import MAMLConfig
+from . import datasets as ds
+from .episodes import Episode, sample_episode
+
+Batch = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+class FewShotEpisodicDataset:
+    """Index + splits + per-set seed state (FewShotLearningDatasetParallel,
+    data.py:111-552, minus torch)."""
+
+    def __init__(self, cfg: MAMLConfig, cache_dir: Optional[str] = None):
+        self.cfg = cfg
+        cache_dir = cache_dir or cfg.cache_dir or "."
+        self.init_seed = ds.draw_stream_seeds(cfg)
+        self.seed = dict(self.init_seed)
+        index, idx_to_label, label_to_idx = ds.load_class_index(cfg, cache_dir)
+        self.splits = ds.split_classes(cfg, index, idx_to_label, self.seed["val"])
+        if cfg.load_into_memory:
+            self.splits = ds.preload_to_memory(cfg, self.splits)
+        # class-key ordering per set is the dict insertion order — the
+        # ordering rng.choice sees in the reference (data.py:486)
+        self.class_keys = {
+            name: np.array(list(classes.keys()))
+            for name, classes in self.splits.items()
+        }
+        for name, keys in self.class_keys.items():
+            if len(keys) < cfg.num_classes_per_set:
+                raise ValueError(
+                    f"set {name!r} has {len(keys)} classes < "
+                    f"num_classes_per_set={cfg.num_classes_per_set}"
+                )
+
+    def update_train_seed(self, current_iter: int) -> None:
+        """switch_set('train', it): seed = init + it (data.py:536-542)."""
+        self.seed["train"] = self.init_seed["train"] + current_iter
+
+    def episode(self, set_name: str, idx: int, augment: bool) -> Episode:
+        return sample_episode(
+            self.cfg,
+            self.splits[set_name],
+            self.class_keys[set_name],
+            seed=self.seed[set_name] + idx,
+            augment=augment,
+        )
+
+
+def _stack(episodes) -> Batch:
+    return (
+        np.stack([e.x_support for e in episodes]),
+        np.stack([e.x_target for e in episodes]),
+        np.stack([e.y_support for e in episodes]),
+        np.stack([e.y_target for e in episodes]),
+        np.array([e.seed for e in episodes], np.int64),
+    )
+
+
+class MetaLearningDataLoader:
+    """Batch generators with background prefetch (data.py:555-637)."""
+
+    def __init__(self, cfg: MAMLConfig, current_iter: int = 0,
+                 cache_dir: Optional[str] = None):
+        self.cfg = cfg
+        self.dataset = FewShotEpisodicDataset(cfg, cache_dir)
+        ndev = max(1, cfg.num_of_gpus)
+        self.tasks_per_batch = ndev * cfg.batch_size * cfg.samples_per_iter
+        self.total_train_iters_produced = 0
+        self.continue_from_iter(current_iter)
+
+    def continue_from_iter(self, current_iter: int) -> None:
+        """Fast-forward the train stream after resume (data.py:583-588)."""
+        self.total_train_iters_produced += current_iter * self.tasks_per_batch
+
+    def _batches(
+        self, set_name: str, total_batches: int, augment: bool
+    ) -> Iterator[Batch]:
+        cfg = self.cfg
+        dataset = self.dataset
+        tpb = self.tasks_per_batch
+        workers = max(1, cfg.num_dataprovider_workers)
+        prefetch = max(1, cfg.prefetch_batches)
+        out: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                    for b in range(total_batches):
+                        if stop.is_set():
+                            return
+                        idxs = range(b * tpb, (b + 1) * tpb)
+                        eps = list(
+                            pool.map(
+                                lambda i: dataset.episode(set_name, i, augment),
+                                idxs,
+                            )
+                        )
+                        out.put(_stack(eps))
+                out.put(None)
+            except BaseException as exc:  # surface worker errors to consumer
+                out.put(exc)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = out.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    def get_train_batches(
+        self, total_batches: int, augment_images: bool = False
+    ) -> Iterator[Batch]:
+        self.dataset.update_train_seed(self.total_train_iters_produced)
+        # advanced once per generator CALL, not per batch — reference quirk
+        # the resume arithmetic depends on (data.py:598-602)
+        self.total_train_iters_produced += self.tasks_per_batch
+        return self._batches("train", total_batches, augment_images)
+
+    def get_val_batches(
+        self, total_batches: int, augment_images: bool = False
+    ) -> Iterator[Batch]:
+        return self._batches("val", total_batches, augment_images)
+
+    def get_test_batches(
+        self, total_batches: int, augment_images: bool = False
+    ) -> Iterator[Batch]:
+        return self._batches("test", total_batches, augment_images)

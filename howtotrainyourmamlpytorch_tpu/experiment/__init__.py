@@ -1,0 +1,3 @@
+from . import builder, checkpoint, system
+from .builder import ExperimentBuilder
+from .system import MAMLFewShotClassifier

@@ -1,0 +1,258 @@
+"""Fault-tolerant experiment orchestration.
+
+Re-implementation of the reference's ``ExperimentBuilder``
+(experiment_builder.py:10-371): an iteration-counted train loop with
+
+* validation every ``total_iter_per_epoch`` iterations over
+  ``num_evaluation_tasks`` fixed tasks (:327-337);
+* best-val tracking (:339-344) and per-epoch + ``latest`` checkpoints
+  (:190-206, 352);
+* kill-safe resume from ``latest`` (default) / ``from_scratch`` / an epoch
+  index (:32-51), incl. fast-forwarding the deterministic task stream
+  (:53 -> data.py:583-588);
+* per-epoch mean/std of every metric appended to
+  ``logs/summary_statistics.csv`` and mirrored to ``summary_statistics.json``
+  (:208-245, 354-365);
+* controlled pause for preemptible clusters after
+  ``total_epochs_before_pause`` epochs (:367-370);
+* final test = ensemble of the top-5 validation checkpoints: mean of
+  per-model softmax preds, argmax, accuracy ± std -> ``test_summary.csv``
+  (:247-300).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import MAMLConfig
+from ..utils.storage import (
+    build_experiment_folder,
+    save_statistics,
+    save_to_json,
+)
+from .checkpoint import checkpoint_exists
+from .system import MAMLFewShotClassifier
+
+
+class ExperimentBuilder:
+    def __init__(
+        self,
+        cfg: MAMLConfig,
+        model: MAMLFewShotClassifier,
+        data_loader_cls,
+        experiment_root: str = ".",
+        verbose: bool = True,
+    ):
+        self.cfg = cfg
+        self.model = model
+        self.verbose = verbose
+        (
+            self.saved_models_filepath,
+            self.logs_filepath,
+            self.samples_filepath,
+        ) = build_experiment_folder(cfg.experiment_name, root=experiment_root)
+
+        self.total_losses: Dict[str, List[float]] = {}
+        self.state: Dict = {"best_val_acc": 0.0, "best_val_iter": 0, "current_iter": 0}
+        self.start_epoch = 0
+        self.create_summary_csv = False
+
+        # resume logic (experiment_builder.py:32-51)
+        cont = str(cfg.continue_from_epoch)
+        if cont == "from_scratch":
+            self.create_summary_csv = True
+        elif cont == "latest":
+            if checkpoint_exists(self.saved_models_filepath, "train_model", "latest"):
+                self.state = self.model.load_model(self.saved_models_filepath, "latest")
+                self.start_epoch = int(
+                    self.state["current_iter"] // cfg.total_iter_per_epoch
+                )
+            else:
+                self.create_summary_csv = True
+        elif int(cont) >= 0:
+            self.state = self.model.load_model(self.saved_models_filepath, int(cont))
+            self.start_epoch = int(
+                self.state["current_iter"] // cfg.total_iter_per_epoch
+            )
+
+        # data stream fast-forwarded to the resume point
+        # (experiment_builder.py:53)
+        self.data = data_loader_cls(
+            cfg,
+            current_iter=self.state["current_iter"],
+            cache_dir=cfg.cache_dir or self.logs_filepath,
+        )
+
+        self.epoch = int(self.state["current_iter"] // cfg.total_iter_per_epoch)
+        self.state["best_epoch"] = int(
+            self.state.get("best_val_iter", 0) // cfg.total_iter_per_epoch
+        )
+        # train-time augmentation only for omniglot (experiment_builder.py:60)
+        self.augment_flag = "omniglot" in cfg.dataset_name.lower()
+        self.start_time = time.time()
+        self.epochs_done_in_this_run = 0
+
+    # -- helpers (experiment_builder.py:66-100) ---------------------------
+
+    @staticmethod
+    def build_summary_dict(total_losses, phase, summary_losses=None):
+        if summary_losses is None:
+            summary_losses = {}
+        for key in total_losses:
+            summary_losses[f"{phase}_{key}_mean"] = float(np.mean(total_losses[key]))
+            summary_losses[f"{phase}_{key}_std"] = float(np.std(total_losses[key]))
+        return summary_losses
+
+    def _log(self, msg: str):
+        if self.verbose:
+            print(msg, flush=True)
+
+    def _accumulate(self, losses: Dict[str, float], total_losses):
+        for key, value in losses.items():
+            total_losses.setdefault(key, []).append(float(value))
+
+    # -- phases -----------------------------------------------------------
+
+    def train_iteration(self, train_sample, epoch_idx):
+        x_s, x_t, y_s, y_t = train_sample[:4]
+        losses = self.model.run_train_iter((x_s, x_t, y_s, y_t), epoch=epoch_idx)
+        self._accumulate(losses, self.total_losses)
+        self.state["current_iter"] += 1
+        return self.build_summary_dict(self.total_losses, "train")
+
+    def evaluation_iteration(self, val_sample, total_losses, phase: str):
+        x_s, x_t, y_s, y_t = val_sample[:4]
+        losses, _ = self.model.run_validation_iter((x_s, x_t, y_s, y_t))
+        self._accumulate(losses, total_losses)
+        return self.build_summary_dict(total_losses, phase)
+
+    def run_validation_epoch(self) -> Dict[str, float]:
+        total_losses: Dict[str, List[float]] = {}
+        val_losses: Dict[str, float] = {}
+        n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
+        for val_sample in self.data.get_val_batches(total_batches=n_batches):
+            val_losses = self.evaluation_iteration(val_sample, total_losses, "val")
+        return val_losses
+
+    def pack_and_save_metrics(self, train_losses, val_losses):
+        """Per-epoch CSV/JSON metric rows (experiment_builder.py:208-245)."""
+        epoch_summary = {**train_losses, **val_losses}
+        self.state.setdefault("per_epoch_statistics", {})
+        for key, value in epoch_summary.items():
+            self.state["per_epoch_statistics"].setdefault(key, []).append(value)
+        epoch_summary["epoch"] = self.epoch
+        epoch_summary["epoch_run_time"] = time.time() - self.start_time
+        if self.create_summary_csv:
+            save_statistics(self.logs_filepath, list(epoch_summary.keys()), create=True)
+            self.create_summary_csv = False
+        self.start_time = time.time()
+        self._log(f"epoch {self.epoch} -> " + ", ".join(
+            f"{k}: {v:.4f}" for k, v in epoch_summary.items()
+            if "loss" in k or "accuracy" in k
+        ))
+        save_statistics(self.logs_filepath, list(epoch_summary.values()))
+
+    # -- the loop (experiment_builder.py:302-371) -------------------------
+
+    def run_experiment(self):
+        cfg = self.cfg
+        total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
+        while (
+            self.state["current_iter"] < total_iters
+            and not cfg.evaluate_on_test_set_only
+        ):
+            remaining = total_iters - self.state["current_iter"]
+            for train_sample in self.data.get_train_batches(
+                total_batches=remaining, augment_images=self.augment_flag
+            ):
+                epoch_idx = self.state["current_iter"] / cfg.total_iter_per_epoch
+                train_losses = self.train_iteration(train_sample, epoch_idx)
+
+                if self.state["current_iter"] % cfg.total_iter_per_epoch == 0:
+                    val_losses = self.run_validation_epoch()
+                    if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
+                        self._log(
+                            f"Best validation accuracy "
+                            f"{val_losses['val_accuracy_mean']:.4f}"
+                        )
+                        self.state["best_val_acc"] = val_losses["val_accuracy_mean"]
+                        self.state["best_val_iter"] = self.state["current_iter"]
+                        self.state["best_epoch"] = int(
+                            self.state["best_val_iter"] // cfg.total_iter_per_epoch
+                        )
+                    self.epoch += 1
+                    self.state.update(train_losses)
+                    self.state.update(val_losses)
+
+                    # dual checkpoint: epoch-numbered + latest (:190-206)
+                    self.model.save_model(
+                        self.saved_models_filepath, int(self.epoch), self.state
+                    )
+                    self.model.save_model(
+                        self.saved_models_filepath, "latest", self.state
+                    )
+                    self.pack_and_save_metrics(train_losses, val_losses)
+                    self.total_losses = {}
+                    self.epochs_done_in_this_run += 1
+                    save_to_json(
+                        os.path.join(self.logs_filepath, "summary_statistics.json"),
+                        self.state["per_epoch_statistics"],
+                    )
+                    if self.epochs_done_in_this_run >= cfg.total_epochs_before_pause:
+                        # controlled pause for preemptible clusters (:367-370)
+                        self._log(
+                            f"pause after {self.epochs_done_in_this_run} epochs"
+                        )
+                        sys.exit()
+        return self.evaluated_test_set_using_the_best_models(top_n_models=5)
+
+    # -- final test ensemble (experiment_builder.py:247-300) --------------
+
+    def evaluated_test_set_using_the_best_models(self, top_n_models: int = 5):
+        per_epoch = self.state["per_epoch_statistics"]
+        val_acc = np.copy(per_epoch["val_accuracy_mean"])
+        sorted_idx = np.argsort(val_acc, axis=0).astype(np.int32)[::-1][:top_n_models]
+        self._log(f"top-{top_n_models} val epochs {sorted_idx} acc {val_acc[sorted_idx]}")
+
+        n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
+        per_model_preds: List[List[np.ndarray]] = [[] for _ in sorted_idx]
+        per_model_targets: List[List[np.ndarray]] = [[] for _ in sorted_idx]
+        for idx, model_idx in enumerate(sorted_idx):
+            # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
+            # (experiment_builder.py:265): epoch counter is 1-based at save
+            self.state = self.model.load_model(
+                self.saved_models_filepath, int(model_idx) + 1
+            )
+            for test_sample in self.data.get_test_batches(total_batches=n_batches):
+                x_s, x_t, y_s, y_t = test_sample[:4]
+                _, preds = self.model.run_validation_iter((x_s, x_t, y_s, y_t))
+                per_model_preds[idx].extend(list(preds))
+                per_model_targets[idx].extend(
+                    list(np.asarray(y_t).reshape(len(preds), -1))
+                )
+
+        # ensemble: mean softmax over models -> argmax (:282-288)
+        per_batch_preds = np.mean(np.array(per_model_preds), axis=0)
+        per_batch_max = np.argmax(per_batch_preds, axis=2)
+        per_batch_targets = np.array(per_model_targets[0]).reshape(per_batch_max.shape)
+        accuracy = float(np.mean(np.equal(per_batch_targets, per_batch_max)))
+        accuracy_std = float(np.std(np.equal(per_batch_targets, per_batch_max)))
+        test_losses = {
+            "test_accuracy_mean": accuracy,
+            "test_accuracy_std": accuracy_std,
+        }
+        save_statistics(
+            self.logs_filepath, list(test_losses.keys()),
+            create=True, filename="test_summary.csv",
+        )
+        save_statistics(
+            self.logs_filepath, list(test_losses.values()),
+            filename="test_summary.csv",
+        )
+        self._log(str(test_losses))
+        return test_losses

@@ -1,0 +1,143 @@
+"""The meta-learning system facade: host-side driver of the jitted steps.
+
+The interface mirrors the reference's ``MAMLFewShotClassifier``
+(few_shot_learning_system.py:26-424) — ``run_train_iter`` /
+``run_validation_iter`` / ``save_model`` / ``load_model`` — so the experiment
+builder layer maps one-to-one. Per-iteration host logic (all cheap scalars):
+
+* cosine LR from the integer epoch (ref scheduler.step(epoch), :345-346);
+* MSL weight vector for the epoch (ref :83-103, gate :232);
+* first/second-order selection (ref :304-305) — picks between two compiled
+  step variants;
+* batch conversion NCHW->NHWC if needed and task-axis sharding over the mesh.
+
+Everything heavy is inside the two jitted step functions (core.maml).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import MAMLConfig
+from ..core import maml, msl
+from ..parallel import mesh as mesh_lib
+from . import checkpoint as ckpt
+
+
+def _to_nhwc(x: np.ndarray) -> np.ndarray:
+    """Accept reference-layout (..., c, h, w) batches and convert to NHWC.
+
+    Heuristic: channels axis is -3 when it is 1 or 3 and trailing two dims
+    are equal (h == w for both supported datasets).
+    """
+    if x.shape[-1] in (1, 3):
+        return x
+    if x.shape[-3] in (1, 3):
+        return np.moveaxis(x, -3, -1)
+    raise ValueError(f"cannot infer layout of batch with shape {x.shape}")
+
+
+class MAMLFewShotClassifier:
+    """Host-side system object owning state + compiled steps."""
+
+    def __init__(self, cfg: MAMLConfig, use_mesh: bool = True):
+        self.cfg = cfg
+        self.current_epoch = 0
+        self.state = maml.init_state(cfg)
+        self.mesh = None
+        if use_mesh and len(jax.devices()) > 1:
+            n = cfg.num_devices if cfg.num_devices > 0 else len(jax.devices())
+            # the mesh size must divide the meta-batch
+            total_tasks = cfg.batch_size * max(1, cfg.samples_per_iter)
+            while n > 1 and total_tasks % n != 0:
+                n -= 1
+            if n > 1:
+                self.mesh = mesh_lib.task_mesh(n)
+                self.state = mesh_lib.replicate_state(self.mesh, self.state)
+        self._train_steps: Dict[bool, Any] = {}
+        self._eval_step = jax.jit(maml.make_eval_step(cfg))
+
+    # -- step selection ---------------------------------------------------
+
+    def _train_step(self, second_order: bool):
+        if second_order not in self._train_steps:
+            self._train_steps[second_order] = jax.jit(
+                maml.make_train_step(self.cfg, second_order),
+                donate_argnums=(0,),
+            )
+        return self._train_steps[second_order]
+
+    def _prepare_batch(self, data_batch):
+        x_s, x_t, y_s, y_t = data_batch[:4]
+        x_s = _to_nhwc(np.asarray(x_s, np.float32))
+        x_t = _to_nhwc(np.asarray(x_t, np.float32))
+        y_s = np.asarray(y_s, np.int32)
+        y_t = np.asarray(y_t, np.int32)
+        if self.mesh is not None:
+            x_s, y_s, x_t, y_t = mesh_lib.shard_batch(
+                self.mesh, x_s, y_s, x_t, y_t
+            )
+        return x_s, y_s, x_t, y_t
+
+    # -- public API (reference-shaped) ------------------------------------
+
+    def run_train_iter(self, data_batch, epoch) -> Dict[str, float]:
+        """One outer-loop update (ref :338-369). Returns the losses dict with
+        the reference's keys (loss, accuracy, loss_importance_vector_i,
+        learning_rate)."""
+        epoch = int(epoch)
+        self.current_epoch = epoch
+        cfg = self.cfg
+        lr = maml.cosine_lr(cfg, epoch)
+        weights = msl.loss_weights_for(
+            cfg.number_of_training_steps_per_iter,
+            cfg.use_multi_step_loss_optimization,
+            True,
+            epoch,
+            cfg.multi_step_loss_num_epochs,
+        )
+        second_order = bool(
+            cfg.second_order and epoch > cfg.first_order_to_second_order_epoch
+        )
+        x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
+        self.state, metrics = self._train_step(second_order)(
+            self.state, x_s, y_s, x_t, y_t, weights, lr
+        )
+        losses = {k: float(v) for k, v in metrics.items()}
+        # per-step MSL weights logged each iteration (ref :260-262)
+        anneal = msl.per_step_loss_importance(
+            cfg.number_of_training_steps_per_iter,
+            cfg.multi_step_loss_num_epochs,
+            epoch,
+        )
+        for i, w in enumerate(anneal):
+            losses[f"loss_importance_vector_{i}"] = float(w)
+        losses["learning_rate"] = float(lr)  # ref :365
+        return losses
+
+    def run_validation_iter(self, data_batch) -> Tuple[Dict[str, float], np.ndarray]:
+        """One evaluation pass (ref :371-397). Returns (losses,
+        per-task softmax predictions for the test-time ensemble)."""
+        x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
+        metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
+        return {k: float(v) for k, v in metrics.items()}, np.asarray(preds)
+
+    # -- checkpointing (ref :399-424) -------------------------------------
+
+    def save_model(self, model_save_dir: str, model_idx,
+                   experiment_state: Dict[str, Any]) -> str:
+        return ckpt.save_checkpoint(
+            model_save_dir, "train_model", model_idx, self.state,
+            experiment_state,
+        )
+
+    def load_model(self, model_save_dir: str, model_idx) -> Dict[str, Any]:
+        self.state, experiment_state = ckpt.load_checkpoint(
+            model_save_dir, "train_model", model_idx, self.state
+        )
+        if self.mesh is not None:
+            self.state = mesh_lib.replicate_state(self.mesh, self.state)
+        return experiment_state

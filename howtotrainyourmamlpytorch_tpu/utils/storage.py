@@ -1,0 +1,64 @@
+"""Experiment folders, CSV/JSON metrics storage.
+
+Functional equivalent of the reference's ``utils/storage.py`` (:1-128):
+``build_experiment_folder`` (:49-66) creates ``saved_models/ logs/
+visual_outputs/``; ``save_statistics`` (:18-29) appends rows to a summary
+CSV; ``save_to_json``/``load_from_json`` (:8-16) mirror the JSON metrics
+dump (experiment_builder.py:364-365).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+
+def build_experiment_folder(experiment_name: str, root: str = ".") -> Tuple[str, str, str]:
+    """Create <root>/<name>/{saved_models,logs,visual_outputs} (ref :49-66)."""
+    base = os.path.abspath(os.path.join(root, experiment_name))
+    saved_models = os.path.join(base, "saved_models")
+    logs = os.path.join(base, "logs")
+    samples = os.path.join(base, "visual_outputs")
+    for d in (saved_models, logs, samples):
+        os.makedirs(d, exist_ok=True)
+    return saved_models, logs, samples
+
+
+def save_statistics(
+    log_dir: str,
+    line_to_add: Iterable,
+    filename: str = "summary_statistics.csv",
+    create: bool = False,
+) -> str:
+    """Append one row (header row when ``create``) to the stats CSV (ref :18-29)."""
+    summary_filename = os.path.join(log_dir, filename)
+    mode = "w" if create else "a"
+    with open(summary_filename, mode) as f:
+        writer = csv.writer(f)
+        writer.writerow(list(line_to_add))
+    return summary_filename
+
+
+def load_statistics(log_dir: str, filename: str = "summary_statistics.csv") -> Dict[str, List[str]]:
+    """Read the stats CSV back into {column: [values]} (ref :31-46)."""
+    path = os.path.join(log_dir, filename)
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    keys = rows[0]
+    data: Dict[str, List[str]] = {k: [] for k in keys}
+    for row in rows[1:]:
+        for k, v in zip(keys, row):
+            data[k].append(v)
+    return data
+
+
+def save_to_json(filename: str, dict_to_store: dict) -> None:
+    with open(os.path.abspath(filename), "w") as f:
+        json.dump(dict_to_store, f)
+
+
+def load_from_json(filename: str) -> dict:
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,59 @@
+"""Entry point: config -> model -> data -> experiment (ref:
+train_maml_system.py:8-15).
+
+Usage:
+    python train_maml_system.py --name_of_args_json_file experiment_config/x.json
+    python train_maml_system.py --experiment_name foo --dataset_name omniglot_dataset ...
+
+Any MAMLConfig field can be overridden on the command line; a JSON config
+file (reference format) supplies the rest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
+
+
+def get_args(argv=None) -> MAMLConfig:
+    parser = argparse.ArgumentParser(
+        description="TPU-native MAML++ training and inference system"
+    )
+    parser.add_argument("--name_of_args_json_file", type=str, default="None")
+    for f in dataclasses.fields(MAMLConfig):
+        if f.name == "name_of_args_json_file":
+            continue
+        parser.add_argument(f"--{f.name}", type=str, default=None)
+    ns = parser.parse_args(argv)
+    overrides = {
+        k: v for k, v in vars(ns).items()
+        if v is not None and k != "name_of_args_json_file"
+    }
+    # cast strings to the declared field types
+    types = {f.name: f.type for f in dataclasses.fields(MAMLConfig)}
+    for k, v in list(overrides.items()):
+        t = types.get(k, "str")
+        if t in ("int", int):
+            overrides[k] = int(v)
+        elif t in ("float", float):
+            overrides[k] = float(v)
+    if ns.name_of_args_json_file != "None":
+        return MAMLConfig.from_json_file(ns.name_of_args_json_file, **overrides)
+    return MAMLConfig(**overrides)
+
+
+def main(argv=None):
+    cfg = get_args(argv)
+    model = MAMLFewShotClassifier(cfg)
+    builder = ExperimentBuilder(cfg, model, MetaLearningDataLoader)
+    builder.run_experiment()
+
+
+if __name__ == "__main__":
+    main()
