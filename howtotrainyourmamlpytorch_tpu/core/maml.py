@@ -183,6 +183,49 @@ def _merge_bn(bn_batched: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     return jax.tree_util.tree_map(lambda v: jnp.mean(v, axis=0), bn_batched)
 
 
+def _meta_loss_and_grads(learner, state, x_s, y_s, x_t, y_t, loss_weights):
+    """Outer loss + meta-gradients over the vmapped task batch."""
+
+    def outer_loss(trainable):
+        per_task = jax.vmap(
+            lambda xs, ys, xt, yt: learner(
+                trainable["net"], trainable["lslr"], state.bn,
+                xs, ys, xt, yt, loss_weights,
+            )
+        )
+        losses, (correct, bns, _) = per_task(x_s, y_s, x_t, y_t)
+        # mean over tasks (few_shot_learning_system.py:164)
+        return jnp.mean(losses), (correct, bns)
+
+    trainable = {"net": state.net, "lslr": state.lslr}
+    (loss, (correct, bns)), grads = jax.value_and_grad(
+        outer_loss, has_aux=True
+    )(trainable)
+    return trainable, loss, correct, bns, grads
+
+
+def make_grads_fn(cfg: MAMLConfig, second_order: bool):
+    """The meta-gradient computation alone (no optimizer update).
+
+    Used by equivalence tests (remat vs no-remat, sharded vs single-device):
+    post-Adam weights are the wrong comparison surface because Adam's
+    sign-normalization amplifies float-reordering noise on parameters whose
+    true gradient is ~0 (e.g. a conv bias followed by batch-norm) into
+    O(lr) weight differences.
+    """
+    learner = _task_learner(
+        cfg, cfg.number_of_training_steps_per_iter, second_order
+    )
+
+    def grads_fn(state: MetaState, x_s, y_s, x_t, y_t, loss_weights):
+        _, loss, _, _, grads = _meta_loss_and_grads(
+            learner, state, x_s, y_s, x_t, y_t, loss_weights
+        )
+        return loss, grads
+
+    return grads_fn
+
+
 def make_train_step(cfg: MAMLConfig, second_order: bool):
     """Build the jitted outer step: vmap over tasks, grad, Adam.
 
@@ -195,21 +238,9 @@ def make_train_step(cfg: MAMLConfig, second_order: bool):
         # labels depend only on (static) key names, so building the transform
         # inside the traced function is free
         opt = make_optimizer(cfg, state.net)
-        def outer_loss(trainable):
-            per_task = jax.vmap(
-                lambda xs, ys, xt, yt: learner(
-                    trainable["net"], trainable["lslr"], state.bn,
-                    xs, ys, xt, yt, loss_weights,
-                )
-            )
-            losses, (correct, bns, _) = per_task(x_s, y_s, x_t, y_t)
-            # mean over tasks (few_shot_learning_system.py:164)
-            return jnp.mean(losses), (correct, bns)
-
-        trainable = {"net": state.net, "lslr": state.lslr}
-        (loss, (correct, bns)), grads = jax.value_and_grad(
-            outer_loss, has_aux=True
-        )(trainable)
+        trainable, loss, correct, bns, grads = _meta_loss_and_grads(
+            learner, state, x_s, y_s, x_t, y_t, loss_weights
+        )
         if cfg.clip_grads:
             # elementwise clamp to ±10, net params only
             # (few_shot_learning_system.py:332-335)

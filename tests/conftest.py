@@ -1,0 +1,89 @@
+"""Test environment: CPU backend with 8 virtual devices.
+
+Multi-chip sharding paths are validated without TPU hardware by forcing the
+host platform to present 8 devices (the TPU-native answer to testing
+multi-device code on one machine — SURVEY.md §4). Must run before jax's
+first import anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The sandbox's sitecustomize registers an experimental TPU-tunnel backend
+# and force-updates jax_platforms at interpreter start, overriding the env
+# var above; re-update so tests never try to initialise the tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+REFERENCE_ROOT = "/root/reference"
+OMNIGLOT_PATH = os.path.join(REFERENCE_ROOT, "datasets", "omniglot_dataset")
+
+needs_omniglot = pytest.mark.skipif(
+    not os.path.isdir(OMNIGLOT_PATH), reason="omniglot dataset not available"
+)
+needs_torch = pytest.mark.skipif(
+    not bool(__import__("importlib").util.find_spec("torch")),
+    reason="torch (oracle) not available",
+)
+
+
+@pytest.fixture
+def tiny_cfg() -> MAMLConfig:
+    """A minimal MAML++ config: all MAML++ mechanisms on, tiny shapes."""
+    return MAMLConfig(
+        dataset_name="omniglot_dataset",
+        image_height=14,
+        image_width=14,
+        image_channels=1,
+        num_classes_per_set=4,
+        num_samples_per_class=1,
+        num_target_samples=2,
+        batch_size=4,
+        cnn_num_filters=6,
+        num_stages=2,
+        max_pooling=False,
+        conv_padding=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True,
+        second_order=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        multi_step_loss_num_epochs=3,
+        total_epochs=5,
+        total_iter_per_epoch=4,
+        use_remat=False,
+    )
+
+
+@pytest.fixture
+def synthetic_batch():
+    """A deterministic synthetic task batch, NHWC."""
+
+    def make(cfg: MAMLConfig, batch_size=None, seed=0):
+        rng = np.random.RandomState(seed)
+        b = batch_size or cfg.batch_size
+        n = cfg.num_classes_per_set
+        s, t = cfg.num_samples_per_class, cfg.num_target_samples
+        h, w, c = cfg.im_shape
+        # class-dependent means so tasks are learnable
+        means = rng.randn(b, n, 1, 1, 1, 1).astype(np.float32)
+        x_s = rng.randn(b, n, s, h, w, c).astype(np.float32) * 0.1 + means
+        x_t = rng.randn(b, n, t, h, w, c).astype(np.float32) * 0.1 + means
+        y_s = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, s))
+        y_t = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, t))
+        return x_s, y_s, x_t, y_t
+
+    return make

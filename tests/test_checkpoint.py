@@ -1,0 +1,68 @@
+"""Checkpoint round-trip and resume tests (ref contract:
+few_shot_learning_system.py:399-424, experiment_builder.py:190-206)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.core import maml
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+
+def _tree_equal(a, b):
+    ok = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))), a, b
+    )
+    return all(jax.tree_util.tree_leaves(ok))
+
+
+def test_round_trip_exact(tiny_cfg, tmp_path, synthetic_batch):
+    cfg = tiny_cfg
+    state = maml.init_state(cfg)
+    # advance a step so Adam state is nontrivial
+    x_s, y_s, x_t, y_t = synthetic_batch(cfg)
+    import howtotrainyourmamlpytorch_tpu.core.msl as msl
+
+    w = jnp.asarray(msl.per_step_loss_importance(2, 3, 0))
+    state, _ = jax.jit(maml.make_train_step(cfg, True))(
+        state, x_s, y_s, x_t, y_t, w, 0.001
+    )
+    exp_state = {"best_val_acc": 0.5, "best_val_iter": 7, "current_iter": 12,
+                 "per_epoch_statistics": {"val_accuracy_mean": [0.4, 0.5]}}
+    ckpt.save_checkpoint(str(tmp_path), "train_model", "latest", state, exp_state)
+    assert ckpt.checkpoint_exists(str(tmp_path), "train_model", "latest")
+
+    fresh = maml.init_state(cfg)
+    assert not _tree_equal(fresh.net, state.net)
+    restored, exp_restored = ckpt.load_checkpoint(
+        str(tmp_path), "train_model", "latest", fresh
+    )
+    assert _tree_equal(restored.net, state.net)
+    assert _tree_equal(restored.lslr, state.lslr)
+    assert _tree_equal(restored.bn, state.bn)
+    assert _tree_equal(restored.opt, state.opt)
+    assert exp_restored == exp_state
+
+
+def test_epoch_and_latest_are_independent(tiny_cfg, tmp_path):
+    cfg = tiny_cfg
+    s1 = maml.init_state(cfg, seed=1)
+    s2 = maml.init_state(cfg, seed=2)
+    ckpt.save_checkpoint(str(tmp_path), "train_model", 1, s1, {"current_iter": 1})
+    ckpt.save_checkpoint(str(tmp_path), "train_model", "latest", s2, {"current_iter": 2})
+    r1, e1 = ckpt.load_checkpoint(str(tmp_path), "train_model", 1, maml.init_state(cfg))
+    rl, el = ckpt.load_checkpoint(str(tmp_path), "train_model", "latest", maml.init_state(cfg))
+    assert _tree_equal(r1.net, s1.net)
+    assert _tree_equal(rl.net, s2.net)
+    assert e1["current_iter"] == 1 and el["current_iter"] == 2
+
+
+def test_overwrite_latest(tiny_cfg, tmp_path):
+    cfg = tiny_cfg
+    s1 = maml.init_state(cfg, seed=1)
+    s2 = maml.init_state(cfg, seed=2)
+    ckpt.save_checkpoint(str(tmp_path), "train_model", "latest", s1, {"current_iter": 1})
+    ckpt.save_checkpoint(str(tmp_path), "train_model", "latest", s2, {"current_iter": 2})
+    r, e = ckpt.load_checkpoint(str(tmp_path), "train_model", "latest", maml.init_state(cfg))
+    assert _tree_equal(r.net, s2.net)
+    assert e["current_iter"] == 2

@@ -1,0 +1,84 @@
+"""Config-system tests: reference JSON compatibility, bool coercion,
+resume-key exclusion (parser_utils.py:58-106)."""
+
+import json
+import os
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+from conftest import REFERENCE_ROOT
+
+REF_CONFIG = os.path.join(
+    REFERENCE_ROOT,
+    "experiment_config",
+    "mini-imagenet_maml++-mini-imagenet_5_2_0.01_48_5_0.json",
+)
+
+
+def test_bool_coercion():
+    cfg = MAMLConfig(second_order="True", max_pooling="false")
+    assert cfg.second_order is True
+    assert cfg.max_pooling is False
+
+
+def test_json_load_ignores_resume_and_unknown_keys(tmp_path):
+    path = tmp_path / "c.json"
+    json.dump(
+        {
+            "batch_size": 7,
+            "continue_from_epoch": 3,
+            "gpu_to_use": 2,
+            "some_unknown_key": 1,
+        },
+        open(path, "w"),
+    )
+    cfg = MAMLConfig.from_json_file(str(path))
+    assert cfg.batch_size == 7
+    assert cfg.continue_from_epoch == "latest"  # default untouched
+    assert cfg.gpu_to_use == 0
+
+
+def test_overrides_beat_json(tmp_path):
+    path = tmp_path / "c.json"
+    json.dump({"batch_size": 7}, open(path, "w"))
+    cfg = MAMLConfig.from_json_file(str(path), batch_size=9)
+    assert cfg.batch_size == 9
+
+
+def test_inner_lr_quirk_preserved_and_fixable():
+    """Reference reads task_learning_rate (0.1 default), never the JSON's
+    init_inner_loop_learning_rate (SURVEY.md §5)."""
+    cfg = MAMLConfig(task_learning_rate=0.1, init_inner_loop_learning_rate=0.01)
+    assert cfg.inner_lr_init == 0.1
+    fixed = cfg.replace(use_config_init_inner_lr=True)
+    assert fixed.inner_lr_init == 0.01
+
+
+def test_clip_grads_only_for_imagenet():
+    assert MAMLConfig(dataset_name="mini_imagenet_full_size").clip_grads
+    assert not MAMLConfig(dataset_name="omniglot_dataset").clip_grads
+
+
+def test_bn_steps_sized_by_max_of_train_eval():
+    cfg = MAMLConfig(
+        number_of_training_steps_per_iter=5,
+        number_of_evaluation_steps_per_iter=7,
+    )
+    assert cfg.bn_num_steps == 7
+
+
+@pytest.mark.skipif(not os.path.exists(REF_CONFIG), reason="reference absent")
+def test_loads_actual_reference_config():
+    cfg = MAMLConfig.from_json_file(REF_CONFIG)
+    assert cfg.batch_size == 2
+    assert cfg.cnn_num_filters == 48
+    assert cfg.num_classes_per_set == 5
+    assert cfg.num_samples_per_class == 5
+    assert cfg.second_order is True
+    assert cfg.per_step_bn_statistics is True
+    assert cfg.use_multi_step_loss_optimization is True
+    assert cfg.sets_are_pre_split is True
+    assert cfg.max_pooling is True
+    assert cfg.total_epochs == 100

@@ -1,0 +1,108 @@
+"""Functional-op parity vs torch.nn.functional (the reference's compute
+primitives — F.conv2d meta_...py:89, F.linear :141, F.batch_norm :246,
+F.layer_norm :314, pools :605/:609). torch (CPU) is the oracle."""
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.ops import functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+
+def _nchw(x):
+    return torch.tensor(np.moveaxis(x, -1, 1).copy())
+
+
+def test_conv2d_matches_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 9, 9, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 5).astype(np.float32)  # HWIO
+    b = rng.randn(5).astype(np.float32)
+    for stride, pad in [(1, 1), (2, 1), (1, 0), (2, 0)]:
+        ours = np.asarray(F.conv2d(x, w, b, stride=stride, padding=pad))
+        w_t = torch.tensor(np.transpose(w, (3, 2, 0, 1)).copy())  # OIHW
+        theirs = TF.conv2d(_nchw(x), w_t, torch.tensor(b), stride=stride,
+                           padding=pad).numpy()
+        np.testing.assert_allclose(ours, np.moveaxis(theirs, 1, -1), atol=1e-4)
+
+
+def test_linear_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 7).astype(np.float32)
+    w = rng.randn(7, 3).astype(np.float32)  # (in, out)
+    b = rng.randn(3).astype(np.float32)
+    ours = np.asarray(F.linear(x, w, b))
+    theirs = TF.linear(torch.tensor(x), torch.tensor(w.T.copy()),
+                       torch.tensor(b)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_batch_norm_matches_torch_training_mode():
+    """Normalization must equal F.batch_norm(training=True) — the
+    reference ALWAYS normalizes with batch stats (meta_...py:246-247)."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 5, 5, 4).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    rm = np.zeros(4, np.float32)
+    rv = np.ones(4, np.float32)
+    ours, new_m, new_v = F.batch_norm(x, gamma, beta, rm.copy(), rv.copy())
+    rm_t, rv_t = torch.tensor(rm), torch.tensor(rv)
+    theirs = TF.batch_norm(
+        _nchw(x), rm_t, rv_t, torch.tensor(gamma), torch.tensor(beta),
+        training=True, momentum=0.1, eps=1e-5,
+    ).numpy()
+    np.testing.assert_allclose(ours, np.moveaxis(theirs, 1, -1), atol=1e-4)
+    # running-stat update must match torch's in-place tracking
+    np.testing.assert_allclose(np.asarray(new_m), rm_t.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_v), rv_t.numpy(), atol=1e-4)
+
+
+def test_layer_norm_matches_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 5, 5, 4).astype(np.float32)
+    gamma = rng.rand(5, 5, 4).astype(np.float32) + 0.5
+    beta = rng.randn(5, 5, 4).astype(np.float32)
+    ours = np.asarray(F.layer_norm(x, gamma, beta))
+    # torch normalizes over (c, h, w); ours over (h, w, c) — same statistics
+    # (full per-sample reduction), affine transposed
+    theirs = TF.layer_norm(
+        _nchw(x), [4, 5, 5],
+        torch.tensor(np.transpose(gamma, (2, 0, 1)).copy()),
+        torch.tensor(np.transpose(beta, (2, 0, 1)).copy()), eps=1e-5,
+    ).numpy()
+    np.testing.assert_allclose(ours, np.moveaxis(theirs, 1, -1), atol=1e-4)
+
+
+def test_max_pool_matches_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    ours = np.asarray(F.max_pool2d(x))
+    theirs = TF.max_pool2d(_nchw(x), kernel_size=2, stride=2).numpy()
+    np.testing.assert_allclose(ours, np.moveaxis(theirs, 1, -1), atol=1e-6)
+
+
+def test_global_avg_pool_matches_torch():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 7, 7, 3).astype(np.float32)
+    ours = np.asarray(F.global_avg_pool2d(x))
+    theirs = TF.avg_pool2d(_nchw(x), 7).numpy()
+    np.testing.assert_allclose(ours, np.moveaxis(theirs, 1, -1), atol=1e-6)
+
+
+def test_cross_entropy_matches_torch():
+    rng = np.random.RandomState(6)
+    logits = rng.randn(10, 5).astype(np.float32)
+    labels = rng.randint(0, 5, 10)
+    ours = float(F.cross_entropy(logits, labels))
+    theirs = float(TF.cross_entropy(torch.tensor(logits), torch.tensor(labels)))
+    assert abs(ours - theirs) < 1e-5
+
+
+def test_leaky_relu_default_slope():
+    x = np.array([-2.0, -0.5, 0.0, 3.0], np.float32)
+    ours = np.asarray(F.leaky_relu(x))
+    theirs = TF.leaky_relu(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-7)

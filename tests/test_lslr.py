@@ -1,0 +1,36 @@
+"""LSLR inner-optimizer unit tests (inner_loop_optimizers.py:55-113)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.core import lslr
+
+
+def test_init_shapes_and_value():
+    # one (steps+1,) vector per adapted param, init at the task LR
+    # (inner_loop_optimizers.py:86-91)
+    p = lslr.init(["a", "b"], num_inner_steps=5, init_learning_rate=0.1)
+    assert set(p) == {"a", "b"}
+    for v in p.values():
+        assert v.shape == (6,)
+        np.testing.assert_allclose(v, 0.1)
+
+
+def test_update_math_per_step():
+    # theta' = theta - lr[name][step] * g (inner_loop_optimizers.py:108-113)
+    weights = {"w": jnp.asarray([1.0, 2.0])}
+    grads = {"w": jnp.asarray([0.5, -1.0])}
+    lrs = {"w": jnp.asarray([0.1, 0.2, 0.3])}
+    out0 = lslr.update_params(weights, grads, lrs, 0)
+    np.testing.assert_allclose(out0["w"], [1 - 0.05, 2 + 0.1], rtol=1e-6)
+    out1 = lslr.update_params(weights, grads, lrs, 1)
+    np.testing.assert_allclose(out1["w"], [1 - 0.1, 2 + 0.2], rtol=1e-6)
+
+
+def test_update_only_touches_given_keys():
+    weights = {"w": jnp.ones(2), "v": jnp.ones(2)}
+    grads = {"w": jnp.ones(2), "v": jnp.zeros(2)}
+    lrs = {"w": jnp.asarray([0.5]), "v": jnp.asarray([0.5])}
+    out = lslr.update_params(weights, grads, lrs, 0)
+    np.testing.assert_allclose(out["w"], 0.5)
+    np.testing.assert_allclose(out["v"], 1.0)

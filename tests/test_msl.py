@@ -1,0 +1,50 @@
+"""MSL schedule unit tests (values from few_shot_learning_system.py:83-103
+computed by hand)."""
+
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.core import msl
+
+
+def test_epoch_zero_uniform():
+    w = msl.per_step_loss_importance(5, 15, epoch=0)
+    np.testing.assert_allclose(w, np.full(5, 0.2), rtol=1e-6)
+
+
+def test_epoch_one_values():
+    # decay_rate = 1/5/15 = 1/75; non-final 0.2 - 1/75; final 0.2 + 4/75
+    w = msl.per_step_loss_importance(5, 15, epoch=1)
+    np.testing.assert_allclose(w[:4], 0.2 - 1.0 / 75, rtol=1e-5)
+    np.testing.assert_allclose(w[4], 0.2 + 4.0 / 75, rtol=1e-5)
+
+
+def test_fully_annealed_floor_and_cap():
+    # at epoch >= 15: non-final floored at 0.03/5 = 0.006,
+    # final capped at 1 - 4*0.006 = 0.976
+    for epoch in (15, 40, 1000):
+        w = msl.per_step_loss_importance(5, 15, epoch=epoch)
+        np.testing.assert_allclose(w[:4], 0.006, rtol=1e-6)
+        np.testing.assert_allclose(w[4], 0.976, rtol=1e-6)
+
+
+def test_sums_to_one_while_annealing():
+    for epoch in range(0, 16):
+        w = msl.per_step_loss_importance(5, 15, epoch=epoch)
+        np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-5)
+
+
+def test_gate_matches_reference_branches():
+    # MSL active only when use_msl and training and epoch < anneal epochs
+    # (few_shot_learning_system.py:232)
+    N = 5
+    active = msl.loss_weights_for(N, True, True, 3, 15)
+    assert active[0] != 0.0
+    for args in [(True, True, 15), (True, True, 99), (True, False, 3), (False, True, 3)]:
+        use, train, ep = args
+        w = msl.loss_weights_for(N, use, train, ep, 15)
+        np.testing.assert_array_equal(w, msl.final_step_only(N))
+
+
+def test_single_step_degenerate():
+    w = msl.per_step_loss_importance(1, 15, epoch=0)
+    np.testing.assert_allclose(w, [1.0])

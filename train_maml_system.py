@@ -13,9 +13,10 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import sys
 
-from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig, _coerce_bool
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
 from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
@@ -35,14 +36,22 @@ def get_args(argv=None) -> MAMLConfig:
         k: v for k, v in vars(ns).items()
         if v is not None and k != "name_of_args_json_file"
     }
-    # cast strings to the declared field types
+    # cast strings to the declared field types; bools accept the reference's
+    # "true"/"false" strings (parser_utils.py:63-66), lists accept JSON
     types = {f.name: f.type for f in dataclasses.fields(MAMLConfig)}
     for k, v in list(overrides.items()):
-        t = types.get(k, "str")
-        if t in ("int", int):
+        t = str(types.get(k, "str"))
+        if t == "int" or t.startswith("Optional[int"):
             overrides[k] = int(v)
-        elif t in ("float", float):
+        elif t == "float":
             overrides[k] = float(v)
+        elif t == "bool":
+            coerced = _coerce_bool(v)
+            if not isinstance(coerced, bool):
+                parser.error(f"--{k} expects 'true' or 'false', got {v!r}")
+            overrides[k] = coerced
+        elif t.startswith("List[") or t.startswith("Tuple["):
+            overrides[k] = json.loads(v)
     if ns.name_of_args_json_file != "None":
         return MAMLConfig.from_json_file(ns.name_of_args_json_file, **overrides)
     return MAMLConfig(**overrides)
