@@ -1,0 +1,105 @@
+"""Benchmark: meta-tasks/sec on the flagship MAML++ config.
+
+Measures the steady-state throughput of the jitted second-order MAML++
+train step (Mini-ImageNet 5-way 5-shot shapes, 48-filter 4-stage backbone,
+5 inner steps — the reference's headline config) with synthetic on-device
+data, so it isolates device compute from input-pipeline effects.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no throughput numbers (BASELINE.md), so
+``vs_baseline`` is measured against our own recorded first-round number
+when present (BENCH_BASELINE.json), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from __graft_entry__ import _flagship_cfg
+from howtotrainyourmamlpytorch_tpu.core import maml, msl
+
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", 3))
+TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", 20))
+
+
+def main() -> None:
+    import jax
+
+    n_chips = max(1, len(jax.devices()))
+    overrides = {}
+    for key in ("batch_size", "cnn_num_filters", "image_height", "image_width",
+                "number_of_training_steps_per_iter"):
+        if f"BENCH_{key.upper()}" in os.environ:
+            overrides[key] = int(os.environ[f"BENCH_{key.upper()}"])
+    # constant per-chip work: 8 tasks/chip unless overridden
+    overrides.setdefault("batch_size", 8 * n_chips)
+    cfg = _flagship_cfg(**overrides)
+    state = maml.init_state(cfg)
+    b = cfg.batch_size
+    n, s, t = (
+        cfg.num_classes_per_set,
+        cfg.num_samples_per_class,
+        cfg.num_target_samples,
+    )
+    h, w, c = cfg.im_shape
+    rng = np.random.RandomState(0)
+    x_s = jax.device_put(rng.randn(b, n, s, h, w, c).astype(np.float32))
+    x_t = jax.device_put(rng.randn(b, n, t, h, w, c).astype(np.float32))
+    y_s = jax.device_put(
+        np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, s))
+    )
+    y_t = jax.device_put(
+        np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, t))
+    )
+    weights = np.asarray(
+        msl.loss_weights_for(
+            cfg.number_of_training_steps_per_iter, True, True, 0,
+            cfg.multi_step_loss_num_epochs,
+        )
+    )
+    if n_chips > 1 and cfg.batch_size % n_chips == 0:
+        # shard the task axis so every chip actually works; tasks/s/chip is
+        # then global throughput / chips
+        from howtotrainyourmamlpytorch_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.task_mesh(n_chips)
+        state = mesh_lib.replicate_state(mesh, state)
+        x_s, y_s, x_t, y_t = mesh_lib.shard_batch(mesh, x_s, y_s, x_t, y_t)
+    step = jax.jit(maml.make_train_step(cfg, second_order=True))
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
+    jax.block_until_ready(state.net)
+
+    start = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
+    jax.block_until_ready(state.net)
+    elapsed = time.perf_counter() - start
+
+    tasks_per_sec = TIMED_STEPS * b / elapsed / n_chips
+
+    baseline = 0.0
+    if os.path.exists("BENCH_BASELINE.json"):
+        with open("BENCH_BASELINE.json") as f:
+            baseline = float(json.load(f).get("value", 0.0))
+    vs_baseline = tasks_per_sec / baseline if baseline > 0 else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "meta_tasks_per_sec_per_chip",
+                "value": round(tasks_per_sec, 3),
+                "unit": "tasks/s/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

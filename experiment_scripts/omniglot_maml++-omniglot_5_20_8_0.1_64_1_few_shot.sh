@@ -1,0 +1,5 @@
+#!/bin/sh
+# TPU launch script (generated). Usage: ./omniglot_maml++-omniglot_5_20_8_0.1_64_1_few_shot.sh [extra CLI overrides]
+cd "$(dirname "$0")/.."
+export DATASET_DIR="${DATASET_DIR:-datasets/}"
+python train_maml_system.py --name_of_args_json_file experiment_config/omniglot_maml++-omniglot_5_20_8_0.1_64_1.json "$@"

@@ -1,0 +1,90 @@
+"""Dataset bootstrap: unpack-and-validate before training starts.
+
+Re-implementation of the reference's ``utils/dataset_tools.py:4-56``
+(``maybe_unzip_dataset`` / ``unzip_file``): if the dataset directory is
+missing, extract ``$DATASET_DIR/<name>.tar.bz2``; then validate the image
+file count for the known datasets (Omniglot 1623x20, Mini-ImageNet 100x600,
+dataset_tools.py:36-38) and delete-and-retry once on mismatch (:49-51).
+
+Differences from the reference: extraction uses Python's ``tarfile`` instead
+of shelling out to ``tar -I pbzip2`` (no external binary dependency; bz2 is
+stdlib), and the re-extract loop is bounded (one retry) instead of unbounded
+recursion.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+
+EXPECTED_COUNTS = {
+    "omniglot_dataset": 1623 * 20,
+    "mini_imagenet": 100 * 600,
+    "mini_imagenet_pkl": 3,
+}
+
+_IMAGE_EXTS = (".jpeg", ".jpg", ".png", ".pkl")
+
+
+def count_dataset_files(dataset_path: str) -> int:
+    total = 0
+    for _, _, files in os.walk(dataset_path):
+        total += sum(1 for f in files if f.lower().endswith(_IMAGE_EXTS))
+    return total
+
+
+def expected_count(dataset_name: str):
+    """Known-dataset file count, or None for user datasets (:41-47)."""
+    if dataset_name == "omniglot_dataset":
+        return EXPECTED_COUNTS["omniglot_dataset"]
+    if "mini_imagenet_pkl" in dataset_name:
+        return EXPECTED_COUNTS["mini_imagenet_pkl"]
+    if "mini_imagenet" in dataset_name:
+        return EXPECTED_COUNTS["mini_imagenet"]
+    return None
+
+
+def unzip_file(archive_path: str, dest_dir: str) -> None:
+    """Extract a .tar.bz2 archive (dataset_tools.py:54-56)."""
+    with tarfile.open(archive_path, "r:bz2") as tf:
+        tf.extractall(dest_dir, filter="data")
+
+
+def maybe_unzip_dataset(cfg) -> None:
+    """Ensure ``cfg.dataset_path`` exists with the right file count.
+
+    Mutates ``cfg.reset_stored_filepaths`` to True after a fresh extraction
+    so stale path caches are rebuilt (dataset_tools.py:27).
+    """
+    dataset_path = cfg.dataset_path.rstrip("/")
+    dataset_dir = os.environ.get(
+        "DATASET_DIR", os.path.dirname(dataset_path) or "."
+    )
+    expected = expected_count(cfg.dataset_name)
+    for attempt in range(2):
+        if not os.path.exists(dataset_path):
+            archive = os.path.join(dataset_dir, f"{cfg.dataset_name}.tar.bz2")
+            if not os.path.exists(archive):
+                raise FileNotFoundError(
+                    f"dataset folder {dataset_path!r} missing and no archive "
+                    f"at {os.path.abspath(archive)}; place the dataset as "
+                    f"explained in README.md"
+                )
+            print(f"[dataset] extracting {archive} -> {dataset_dir}", flush=True)
+            unzip_file(archive, dataset_dir)
+            cfg.reset_stored_filepaths = True
+        if expected is None:
+            return  # user-provided dataset: no count contract
+        total = count_dataset_files(dataset_path)
+        if total == expected:
+            return
+        print(
+            f"[dataset] file count {total} != expected {expected}; "
+            f"removing and re-extracting", flush=True,
+        )
+        shutil.rmtree(dataset_path, ignore_errors=True)
+    raise RuntimeError(
+        f"dataset {cfg.dataset_name!r} failed count validation after "
+        f"re-extraction (expected {expected})"
+    )

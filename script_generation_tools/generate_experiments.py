@@ -1,0 +1,204 @@
+"""Experiment config + launch-script generator.
+
+TPU-native re-design of the reference's offline toolchain
+(script_generation_tools/generate_configs.py:1-136 + generate_scripts.py:1-45):
+instead of `$var$` text substitution over JSON templates, experiments are
+built as typed ``MAMLConfig`` objects and serialized, so every generated file
+is schema-checked at generation time. Outputs keep the reference layout:
+
+* ``experiment_config/<algo>-<experiment_name>.json`` — one per grid point
+  (same hyper-grid as the reference: 3 seeds x {omniglot spc{1,5} way{20,5}
+  bs8 ilr0.1 f64, mini-imagenet spc{1,5} way5 bs2 ilr0.01 f48} x
+  {maml, maml++} = 36 configs);
+* ``experiment_scripts/<config>_few_shot.sh`` — one TPU launch script per
+  config (no CUDA_VISIBLE_DEVICES; device selection is JAX's job).
+
+Run from the repo root:  python script_generation_tools/generate_experiments.py
+
+Deliberate deviation: generated configs set ``task_learning_rate`` to the
+grid's inner-loop LR explicitly. The reference's configs write the dead key
+``init_inner_loop_learning_rate`` while the code silently reads
+``task_learning_rate`` (default 0.1) — see SURVEY.md §5. Setting the live key
+makes the intent explicit and is backward-compatible (the reference honours
+JSON ``task_learning_rate`` too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import stat
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+SEEDS = [0, 1, 2]
+
+# hyper-grid (generate_configs.py:30-36)
+GRID = {
+    "omniglot": dict(
+        num_samples_per_class_range=[1, 5],
+        num_classes_range=[20, 5],
+        batch_size_range=[8],
+        init_inner_loop_learning_rate_range=[0.1],
+        num_filters=[64],
+    ),
+    "mini-imagenet": dict(
+        num_samples_per_class_range=[1, 5],
+        num_classes_range=[5],
+        batch_size_range=[2],
+        init_inner_loop_learning_rate_range=[0.01],
+        num_filters=[48],
+    ),
+}
+
+# the three booleans that separate MAML from MAML++ (SURVEY.md §2.3)
+ALGO_FLAGS = {
+    "maml": dict(
+        learnable_per_layer_per_step_inner_loop_learning_rate=False,
+        per_step_bn_statistics=False,
+        use_multi_step_loss_optimization=False,
+    ),
+    "maml++": dict(
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        per_step_bn_statistics=True,
+        use_multi_step_loss_optimization=True,
+    ),
+}
+
+# per-dataset template bodies (experiment_template_config/*.json)
+DATASET_BASE = {
+    "omniglot": dict(
+        dataset_name="omniglot_dataset",
+        dataset_path="omniglot_dataset",
+        image_height=28, image_width=28, image_channels=1,
+        num_target_samples=1,
+        sets_are_pre_split=False,
+        train_val_test_split=[0.70918052988, 0.03080714725, 0.2606284658],
+        indexes_of_folders_indicating_class=[-3, -2],
+        load_into_memory=True,
+        multi_step_loss_num_epochs=10,
+        min_learning_rate=0.00001,
+        total_epochs_before_pause=100,
+    ),
+    "mini-imagenet": dict(
+        dataset_name="mini_imagenet_full_size",
+        dataset_path="mini_imagenet_full_size",
+        image_height=84, image_width=84, image_channels=3,
+        num_target_samples=15,
+        sets_are_pre_split=True,
+        train_val_test_split=[0.64, 0.16, 0.20],
+        indexes_of_folders_indicating_class=[-3, -2],
+        load_into_memory=True,
+        multi_step_loss_num_epochs=15,
+        min_learning_rate=0.001,  # mini-imagenet template: no real annealing
+        total_epochs_before_pause=101,
+    ),
+}
+
+SHARED = dict(
+    num_dataprovider_workers=4,
+    max_models_to_save=5,
+    num_evaluation_tasks=600,
+    enable_inner_loop_optimizable_bn_params=False,
+    total_epochs=100,
+    total_iter_per_epoch=500,
+    max_pooling=True,
+    learnable_bn_gamma=True,
+    learnable_bn_beta=True,
+    meta_learning_rate=0.001,
+    first_order_to_second_order_epoch=-1,
+    norm_layer="batch_norm",
+    num_stages=4,
+    conv_padding=True,
+    number_of_training_steps_per_iter=5,
+    number_of_evaluation_steps_per_iter=5,
+    second_order=True,
+    val_seed=0,
+)
+
+SCRIPT_TEMPLATE = """#!/bin/sh
+# TPU launch script (generated). Usage: ./{name} [extra CLI overrides]
+cd "$(dirname "$0")/.."
+export DATASET_DIR="${{DATASET_DIR:-datasets/}}"
+python train_maml_system.py --name_of_args_json_file experiment_config/{config} "$@"
+"""
+
+
+def grid_points(spec: Dict[str, List]) -> List[Dict]:
+    points = [{}]
+    for key, choices in spec.items():
+        points = [
+            {**p, key.replace("_range", ""): c} for p in points for c in choices
+        ]
+    return points
+
+
+def main(root: str = ".") -> List[str]:
+    cfg_dir = os.path.join(root, "experiment_config")
+    script_dir = os.path.join(root, "experiment_scripts")
+    os.makedirs(cfg_dir, exist_ok=True)
+    os.makedirs(script_dir, exist_ok=True)
+    known = MAMLConfig.known_keys()
+    written = []
+    for seed in SEEDS:
+        for ds_name, spec in GRID.items():
+            for point in grid_points(spec):
+                for algo, flags in ALGO_FLAGS.items():
+                    experiment_name = "{}_{}_{}".format(
+                        ds_name,
+                        "_".join(str(v) for v in point.values()),
+                        seed,
+                    )
+                    fields = dict(SHARED)
+                    fields.update(DATASET_BASE[ds_name])
+                    fields.update(flags)
+                    fields.update(
+                        experiment_name=experiment_name,
+                        train_seed=seed,
+                        batch_size=point["batch_size"],
+                        num_classes_per_set=point["num_classes"],
+                        num_samples_per_class=point["num_samples_per_class"],
+                        init_inner_loop_learning_rate=point[
+                            "init_inner_loop_learning_rate"
+                        ],
+                        task_learning_rate=point["init_inner_loop_learning_rate"],
+                        cnn_num_filters=point["num_filters"],
+                    )
+                    unknown = set(fields) - known
+                    assert not unknown, f"unknown config keys: {unknown}"
+                    cfg = MAMLConfig(**fields)  # schema check
+                    stem = f"{ds_name}_{algo}-{experiment_name}"
+                    cfg_path = os.path.join(cfg_dir, stem + ".json")
+                    with open(cfg_path, "w") as f:
+                        json.dump(
+                            {
+                                k: v for k, v in dataclasses.asdict(cfg).items()
+                                if k in fields
+                            },
+                            f, indent=2, sort_keys=True,
+                        )
+                    script_name = stem + "_few_shot.sh"
+                    script_path = os.path.join(script_dir, script_name)
+                    with open(script_path, "w") as f:
+                        f.write(
+                            SCRIPT_TEMPLATE.format(
+                                name=script_name, config=stem + ".json"
+                            )
+                        )
+                    os.chmod(
+                        script_path,
+                        os.stat(script_path).st_mode
+                        | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH,
+                    )
+                    written.append(cfg_path)
+    print(f"wrote {len(written)} configs to {cfg_dir} (+ scripts)")
+    return written
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ import sys
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig, _coerce_bool
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
 from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
 
 
@@ -60,6 +61,7 @@ def get_args(argv=None) -> MAMLConfig:
 def main(argv=None):
     cfg = get_args(argv)
     model = MAMLFewShotClassifier(cfg)
+    maybe_unzip_dataset(cfg)  # ref train_maml_system.py:12
     builder = ExperimentBuilder(cfg, model, MetaLearningDataLoader)
     builder.run_experiment()
 
