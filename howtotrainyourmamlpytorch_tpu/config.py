@@ -111,6 +111,8 @@ class MAMLConfig:
     use_config_init_inner_lr: bool = False  # fix the task_learning_rate quirk
     cache_dir: str = ""  # where dataset path-index JSON caches go ('' => experiment dir)
     prefetch_batches: int = 2  # host->device pipeline depth
+    profile_trace_dir: str = ""  # jax profiler trace output ('' => disabled)
+    profile_num_steps: int = 5  # train iterations captured in the trace
 
     # --- accepted-but-inert reference keys (SURVEY.md §5 "dead keys") ----
     dropout_rate_value: float = 0.0

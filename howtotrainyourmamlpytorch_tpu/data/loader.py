@@ -86,14 +86,38 @@ def _stack(episodes) -> Batch:
 
 
 class MetaLearningDataLoader:
-    """Batch generators with background prefetch (data.py:555-637)."""
+    """Batch generators with background prefetch (data.py:555-637).
+
+    Multi-host: each process builds only its slice of every global batch
+    (``shard_id``/``num_shards``, defaulting to the JAX process grid). Episode
+    seeds are computed from *global* task indices, so the union of all hosts'
+    slices is bit-identical to a single-host run — the TPU-native analogue of
+    the reference's DataLoader-feeds-DataParallel layout (data.py:580).
+    """
 
     def __init__(self, cfg: MAMLConfig, current_iter: int = 0,
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None,
+                 shard_id: Optional[int] = None,
+                 num_shards: Optional[int] = None):
         self.cfg = cfg
-        self.dataset = FewShotEpisodicDataset(cfg, cache_dir)
         ndev = max(1, cfg.num_of_gpus)
         self.tasks_per_batch = ndev * cfg.batch_size * cfg.samples_per_iter
+        if num_shards is None:
+            if shard_id is not None:
+                raise ValueError("shard_id given without num_shards")
+            import jax
+
+            num_shards = jax.process_count()
+            shard_id = jax.process_index()
+        self.shard_id = shard_id or 0
+        self.num_shards = max(1, num_shards)
+        if self.tasks_per_batch % self.num_shards != 0:
+            raise ValueError(
+                f"tasks per batch {self.tasks_per_batch} not divisible by "
+                f"{self.num_shards} hosts"
+            )
+        self.tasks_per_shard = self.tasks_per_batch // self.num_shards
+        self.dataset = FewShotEpisodicDataset(cfg, cache_dir)
         self.total_train_iters_produced = 0
         self.continue_from_iter(current_iter)
 
@@ -112,13 +136,17 @@ class MetaLearningDataLoader:
         out: "queue.Queue" = queue.Queue(maxsize=prefetch)
         stop = threading.Event()
 
+        lo = self.shard_id * self.tasks_per_shard
+        hi = lo + self.tasks_per_shard
+
         def producer():
             try:
                 with concurrent.futures.ThreadPoolExecutor(workers) as pool:
                     for b in range(total_batches):
                         if stop.is_set():
                             return
-                        idxs = range(b * tpb, (b + 1) * tpb)
+                        # this host's slice of the global batch's task range
+                        idxs = range(b * tpb + lo, b * tpb + hi)
                         eps = list(
                             pool.map(
                                 lambda i: dataset.episode(set_name, i, augment),

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..config import MAMLConfig
+from ..utils.profiling import StepTimer
 from ..utils.storage import (
     build_experiment_folder,
     save_statistics,
@@ -96,6 +97,16 @@ class ExperimentBuilder:
         self.augment_flag = "omniglot" in cfg.dataset_name.lower()
         self.start_time = time.time()
         self.epochs_done_in_this_run = 0
+        # per-step timing as first-class metrics (SURVEY.md §5 — the
+        # reference only records epoch_run_time)
+        self.step_timer = StepTimer()
+        self._tracing = False
+        self._steps_this_run = 0
+        # multi-host: checkpoint saves are collective (orbax), but metric
+        # files are written by the primary process only
+        import jax
+
+        self.is_primary = jax.process_index() == 0
 
     # -- helpers (experiment_builder.py:66-100) ---------------------------
 
@@ -120,10 +131,29 @@ class ExperimentBuilder:
 
     def train_iteration(self, train_sample, epoch_idx):
         x_s, x_t, y_s, y_t = train_sample[:4]
+        self._maybe_profile_step()
         losses = self.model.run_train_iter((x_s, x_t, y_s, y_t), epoch=epoch_idx)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += 1
+        self.step_timer.tick()
+        self._steps_this_run += 1
         return self.build_summary_dict(self.total_losses, "train")
+
+    def _maybe_profile_step(self):
+        """Capture a jax profiler trace of train iterations
+        [1, 1 + profile_num_steps) of this run when ``profile_trace_dir`` is
+        set (iteration 0 is compile, not steady state)."""
+        cfg = self.cfg
+        if not cfg.profile_trace_dir:
+            return
+        import jax
+
+        if not self._tracing and self._steps_this_run == 1:
+            jax.profiler.start_trace(cfg.profile_trace_dir)
+            self._tracing = True
+        elif self._tracing and self._steps_this_run >= 1 + cfg.profile_num_steps:
+            jax.profiler.stop_trace()
+            self._tracing = False
 
     def evaluation_iteration(self, val_sample, total_losses, phase: str):
         x_s, x_t, y_s, y_t = val_sample[:4]
@@ -140,26 +170,44 @@ class ExperimentBuilder:
         return val_losses
 
     def pack_and_save_metrics(self, train_losses, val_losses):
-        """Per-epoch CSV/JSON metric rows (experiment_builder.py:208-245)."""
-        epoch_summary = {**train_losses, **val_losses}
+        """Per-epoch CSV/JSON metric rows (experiment_builder.py:208-245),
+        plus per-step timing stats the reference never had."""
+        epoch_summary = {**train_losses, **val_losses, **self.step_timer.summary()}
+        self.step_timer.reset()
         self.state.setdefault("per_epoch_statistics", {})
         for key, value in epoch_summary.items():
             self.state["per_epoch_statistics"].setdefault(key, []).append(value)
         epoch_summary["epoch"] = self.epoch
         epoch_summary["epoch_run_time"] = time.time() - self.start_time
         if self.create_summary_csv:
-            save_statistics(self.logs_filepath, list(epoch_summary.keys()), create=True)
+            if self.is_primary:
+                save_statistics(
+                    self.logs_filepath, list(epoch_summary.keys()), create=True
+                )
             self.create_summary_csv = False
         self.start_time = time.time()
         self._log(f"epoch {self.epoch} -> " + ", ".join(
             f"{k}: {v:.4f}" for k, v in epoch_summary.items()
             if "loss" in k or "accuracy" in k
         ))
-        save_statistics(self.logs_filepath, list(epoch_summary.values()))
+        if self.is_primary:
+            save_statistics(self.logs_filepath, list(epoch_summary.values()))
 
     # -- the loop (experiment_builder.py:302-371) -------------------------
 
     def run_experiment(self):
+        try:
+            return self._run_experiment()
+        finally:
+            # the trace only materialises at stop — don't lose it when the
+            # run ends/pauses/raises before profile_num_steps completes
+            if self._tracing:
+                import jax
+
+                jax.profiler.stop_trace()
+                self._tracing = False
+
+    def _run_experiment(self):
         cfg = self.cfg
         total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
         while (
@@ -199,10 +247,13 @@ class ExperimentBuilder:
                     self.pack_and_save_metrics(train_losses, val_losses)
                     self.total_losses = {}
                     self.epochs_done_in_this_run += 1
-                    save_to_json(
-                        os.path.join(self.logs_filepath, "summary_statistics.json"),
-                        self.state["per_epoch_statistics"],
-                    )
+                    if self.is_primary:
+                        save_to_json(
+                            os.path.join(
+                                self.logs_filepath, "summary_statistics.json"
+                            ),
+                            self.state["per_epoch_statistics"],
+                        )
                     if self.epochs_done_in_this_run >= cfg.total_epochs_before_pause:
                         # controlled pause for preemptible clusters (:367-370)
                         self._log(
@@ -230,11 +281,14 @@ class ExperimentBuilder:
             )
             for test_sample in self.data.get_test_batches(total_batches=n_batches):
                 x_s, x_t, y_s, y_t = test_sample[:4]
-                _, preds = self.model.run_validation_iter((x_s, x_t, y_s, y_t))
-                per_model_preds[idx].extend(list(preds))
-                per_model_targets[idx].extend(
-                    list(np.asarray(y_t).reshape(len(preds), -1))
+                _, preds = self.model.run_validation_iter(
+                    (x_s, x_t, y_s, y_t), return_preds=True
                 )
+                targets = self.model.gather_across_hosts(
+                    np.asarray(y_t).reshape(np.asarray(y_t).shape[0], -1)
+                )
+                per_model_preds[idx].extend(list(preds))
+                per_model_targets[idx].extend(list(targets))
 
         # ensemble: mean softmax over models -> argmax (:282-288)
         per_batch_preds = np.mean(np.array(per_model_preds), axis=0)
@@ -246,13 +300,14 @@ class ExperimentBuilder:
             "test_accuracy_mean": accuracy,
             "test_accuracy_std": accuracy_std,
         }
-        save_statistics(
-            self.logs_filepath, list(test_losses.keys()),
-            create=True, filename="test_summary.csv",
-        )
-        save_statistics(
-            self.logs_filepath, list(test_losses.values()),
-            filename="test_summary.csv",
-        )
+        if self.is_primary:
+            save_statistics(
+                self.logs_filepath, list(test_losses.keys()),
+                create=True, filename="test_summary.csv",
+            )
+            save_statistics(
+                self.logs_filepath, list(test_losses.values()),
+                filename="test_summary.csv",
+            )
         self._log(str(test_losses))
         return test_losses

@@ -48,7 +48,25 @@ class MAMLFewShotClassifier:
         self.current_epoch = 0
         self.state = maml.init_state(cfg)
         self.mesh = None
-        if use_mesh and len(jax.devices()) > 1:
+        self.multihost = jax.process_count() > 1
+        if self.multihost:
+            # pod-scale: hybrid (hosts, tasks) mesh, DCN x ICI; every process
+            # init'd the same state (deterministic from cfg.seed), replicated
+            # over the global mesh
+            from ..parallel import distributed
+
+            total_tasks = (
+                max(1, cfg.num_of_gpus) * cfg.batch_size * cfg.samples_per_iter
+            )
+            n_dev = len(jax.devices())
+            if total_tasks % n_dev != 0:
+                raise ValueError(
+                    f"global meta-batch of {total_tasks} tasks must divide "
+                    f"the {n_dev} devices of the pod mesh; adjust batch_size"
+                )
+            self.mesh = distributed.hybrid_task_mesh()
+            self.state = mesh_lib.replicate_state(self.mesh, self.state)
+        elif use_mesh and len(jax.devices()) > 1:
             n = cfg.num_devices if cfg.num_devices > 0 else len(jax.devices())
             # the mesh size must divide the meta-batch
             total_tasks = cfg.batch_size * max(1, cfg.samples_per_iter)
@@ -76,6 +94,22 @@ class MAMLFewShotClassifier:
         x_t = _to_nhwc(np.asarray(x_t, np.float32))
         y_s = np.asarray(y_s, np.int32)
         y_t = np.asarray(y_t, np.int32)
+        if self.multihost:
+            # each host holds its slice of the global task axis; assemble the
+            # global sharded arrays without any cross-host copy
+            from ..parallel import distributed
+
+            sharding = distributed.global_batch_sharding(self.mesh)
+            n_hosts = jax.process_count()
+            out = []
+            for a in (x_s, y_s, x_t, y_t):
+                global_shape = (a.shape[0] * n_hosts,) + a.shape[1:]
+                out.append(
+                    jax.make_array_from_process_local_data(
+                        sharding, a, global_shape
+                    )
+                )
+            return tuple(out)
         if self.mesh is not None:
             x_s, y_s, x_t, y_t = mesh_lib.shard_batch(
                 self.mesh, x_s, y_s, x_t, y_t
@@ -118,12 +152,41 @@ class MAMLFewShotClassifier:
         losses["learning_rate"] = float(lr)  # ref :365
         return losses
 
-    def run_validation_iter(self, data_batch) -> Tuple[Dict[str, float], np.ndarray]:
-        """One evaluation pass (ref :371-397). Returns (losses,
-        per-task softmax predictions for the test-time ensemble)."""
+    def run_validation_iter(
+        self, data_batch, return_preds: bool = False
+    ) -> Tuple[Dict[str, float], Optional[np.ndarray]]:
+        """One evaluation pass (ref :371-397). Returns (losses, preds).
+
+        ``return_preds=True`` materialises the per-task softmax predictions
+        on the host (cross-host allgather in multihost mode) — only the test
+        ensemble needs them; plain validation skips the transfer entirely.
+        """
         x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
         metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
-        return {k: float(v) for k, v in metrics.items()}, np.asarray(preds)
+        out_preds = None
+        if return_preds:
+            if self.multihost:
+                # preds are sharded over the global task axis; the ensemble
+                # needs them all on every host
+                from jax.experimental import multihost_utils
+
+                preds = multihost_utils.process_allgather(preds, tiled=True)
+            out_preds = np.asarray(preds)
+        return {k: float(v) for k, v in metrics.items()}, out_preds
+
+    def gather_across_hosts(self, a: np.ndarray) -> np.ndarray:
+        """Concatenate per-host arrays along axis 0 (identity single-host).
+
+        Used by the test ensemble to pair globally-gathered predictions with
+        the matching targets when each host only loaded its batch slice.
+        """
+        if not self.multihost:
+            return np.asarray(a)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(a), tiled=True)
+        )
 
     # -- checkpointing (ref :399-424) -------------------------------------
 

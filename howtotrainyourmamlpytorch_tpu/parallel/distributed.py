@@ -1,0 +1,109 @@
+"""Multi-host (pod-scale) mesh construction over ICI + DCN.
+
+The reference's only cross-device mechanism is single-process
+``nn.DataParallel`` (few_shot_learning_system.py:73-81); it has no
+distributed backend at all (no torch.distributed/NCCL/MPI — SURVEY.md §2.2).
+The TPU-native story needs none of that machinery either: the JAX runtime
+carries collectives over ICI within a slice and DCN across hosts; this module
+just (a) initialises the multi-process runtime from standard env vars and
+(b) builds meshes whose axis order keeps the high-traffic task axis on ICI.
+
+Single-process multi-device (one TPU VM, or the virtual CPU mesh used by
+tests) needs no initialisation — ``task_mesh`` alone suffices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import TASK_AXIS
+
+DATA_AXIS = "hosts"  # DCN-spanning axis for multi-host data parallelism
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialise jax.distributed for multi-host runs.
+
+    Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``); on Cloud TPU pods all three
+    are auto-detected by jax and may stay None. Returns True when the
+    multi-process runtime was initialised, False for single-process runs
+    (no coordinator configured).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("JAX_PROCESS_ID")
+    on_tpu_pod = (
+        os.environ.get("TPU_WORKER_HOSTNAMES", "localhost") != "localhost"
+    )
+    if coordinator_address is None and not on_tpu_pod:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def hybrid_task_mesh(
+    devices: Optional[Sequence] = None,
+    processes: Optional[int] = None,
+) -> Mesh:
+    """A 2-D (hosts, tasks) mesh: DCN-spanning host axis x ICI task axis.
+
+    Axis order puts the host axis first, so XLA maps the *minor* (task) axis
+    onto ICI neighbours within each slice and only the cross-host reduction
+    rides DCN — the outer-gradient psum then does an ICI reduce per slice
+    followed by one small DCN all-reduce (the scaling-book recipe for
+    DP-over-pods). Degenerates to a (1, n) mesh in single-process runs.
+
+    Real multi-process runs go through ``mesh_utils.create_hybrid_device_mesh``
+    (topology-aware; ``jax.devices()`` ordering is not guaranteed
+    process-contiguous). The explicit ``processes`` argument exists for
+    simulating a host axis on a single-process (virtual-device) mesh in tests.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n_proc = processes or jax.process_count()
+    if len(devs) % n_proc != 0:
+        raise ValueError(
+            f"{len(devs)} devices not divisible by {n_proc} processes"
+        )
+    per_host = len(devs) // n_proc
+    if processes is None and jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, per_host),
+            dcn_mesh_shape=(n_proc, 1),
+            devices=devs,
+        )
+    else:
+        # single process (incl. simulated hosts): group by (process, id) so
+        # rows never mix hosts even if the device list is reordered
+        devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+        grid = np.asarray(devs).reshape(n_proc, per_host)
+    return Mesh(grid, (DATA_AXIS, TASK_AXIS))
+
+
+def global_batch_sharding(mesh: Mesh):
+    """Shard a global task axis over both mesh axes (hosts major)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P((DATA_AXIS, TASK_AXIS)))

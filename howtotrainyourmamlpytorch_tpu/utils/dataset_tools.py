@@ -61,10 +61,10 @@ def maybe_unzip_dataset(cfg) -> None:
     dataset_dir = os.environ.get(
         "DATASET_DIR", os.path.dirname(dataset_path) or "."
     )
+    archive = os.path.join(dataset_dir, f"{cfg.dataset_name}.tar.bz2")
     expected = expected_count(cfg.dataset_name)
     for attempt in range(2):
         if not os.path.exists(dataset_path):
-            archive = os.path.join(dataset_dir, f"{cfg.dataset_name}.tar.bz2")
             if not os.path.exists(archive):
                 raise FileNotFoundError(
                     f"dataset folder {dataset_path!r} missing and no archive "
@@ -74,11 +74,25 @@ def maybe_unzip_dataset(cfg) -> None:
             print(f"[dataset] extracting {archive} -> {dataset_dir}", flush=True)
             unzip_file(archive, dataset_dir)
             cfg.reset_stored_filepaths = True
+            if not os.path.exists(dataset_path):
+                raise RuntimeError(
+                    f"extracted {archive} but {dataset_path!r} still does not "
+                    f"exist — the archive's top-level folder must be named "
+                    f"{os.path.basename(dataset_path)!r}"
+                )
         if expected is None:
             return  # user-provided dataset: no count contract
         total = count_dataset_files(dataset_path)
         if total == expected:
             return
+        if not os.path.exists(archive):
+            # never delete the user's only copy: re-extraction is impossible
+            raise RuntimeError(
+                f"dataset {cfg.dataset_name!r} has {total} files, expected "
+                f"{expected}, and no archive exists at "
+                f"{os.path.abspath(archive)} to re-extract from; refusing to "
+                f"delete the existing folder"
+            )
         print(
             f"[dataset] file count {total} != expected {expected}; "
             f"removing and re-extracting", flush=True,

@@ -1,0 +1,68 @@
+"""Tracing / profiling hooks — first-class, unlike the reference.
+
+The reference's observability is tqdm strings and an ``epoch_run_time``
+column (experiment_builder.py:131-132,233); there is no profiler integration
+anywhere (SURVEY.md §5). Here:
+
+* ``maybe_trace`` — context manager starting a JAX/XLA profiler trace
+  (viewable in TensorBoard / Perfetto) when a trace dir is configured;
+* ``StepTimer`` — cheap host-side wall-clock stats per training iteration,
+  surfaced as ``train_iters_per_sec`` / ``train_step_time_ms`` epoch metrics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Wrap a region in a jax.profiler trace when ``trace_dir`` is set."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Rolling per-step wall-time statistics (host-side, negligible cost)."""
+
+    def __init__(self) -> None:
+        self._last: Optional[float] = None
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def tick(self) -> None:
+        """Call once per completed step."""
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            self.count += 1
+            self.total += dt
+            self.min = min(self.min, dt)
+            self.max = max(self.max, dt)
+        self._last = now
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def summary(self, prefix: str = "train") -> Dict[str, float]:
+        if self.count == 0:
+            return {}
+        mean = self.total / self.count
+        return {
+            f"{prefix}_step_time_ms": mean * 1e3,
+            f"{prefix}_step_time_min_ms": self.min * 1e3,
+            f"{prefix}_step_time_max_ms": self.max * 1e3,
+            f"{prefix}_iters_per_sec": 1.0 / mean if mean > 0 else 0.0,
+        }

@@ -174,3 +174,51 @@ def test_rotation_augment_only_when_enabled():
         np.testing.assert_array_equal(
             e_aug.x_support[i, 0], np.rot90(e_plain.x_support[i, 0], k=k)
         )
+
+
+@needs_omniglot
+def test_loader_host_shards_reassemble_global_batch(tmp_path):
+    """Multi-host slicing: the concatenation of every host's slice must be
+    bit-identical to the single-host batch (global-index seed discipline)."""
+    cfg = MAMLConfig(
+        dataset_name="omniglot_dataset", dataset_path=OMNIGLOT_PATH,
+        train_val_test_split=[0.70918052988, 0.03080714725, 0.2606284658],
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=14, image_width=14, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1, num_target_samples=1,
+        batch_size=4, num_dataprovider_workers=2,
+        cache_dir=str(tmp_path),
+    )
+    single = MetaLearningDataLoader(
+        cfg, cache_dir=str(tmp_path), shard_id=0, num_shards=1
+    )
+    (full,) = list(single.get_train_batches(total_batches=1))
+    shards = []
+    for p in range(2):
+        loader = MetaLearningDataLoader(
+            cfg, cache_dir=str(tmp_path), shard_id=p, num_shards=2
+        )
+        assert loader.tasks_per_shard == 2
+        (b,) = list(loader.get_train_batches(total_batches=1))
+        shards.append(b)
+    for i in range(5):  # x_s, x_t, y_s, y_t, seeds
+        np.testing.assert_array_equal(
+            full[i], np.concatenate([shards[0][i], shards[1][i]], axis=0)
+        )
+
+
+def test_loader_rejects_indivisible_shards(tmp_path):
+    cfg = MAMLConfig(
+        dataset_name="omniglot_dataset",
+        dataset_path=OMNIGLOT_PATH,
+        train_val_test_split=[0.70918052988, 0.03080714725, 0.2606284658],
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=14, image_width=14, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1, num_target_samples=1,
+        batch_size=3, cache_dir=str(tmp_path),
+    )
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="not divisible"):
+        MetaLearningDataLoader(
+            cfg, cache_dir=str(tmp_path), shard_id=0, num_shards=2
+        )

@@ -1,0 +1,65 @@
+"""Profiling/timing hooks and hybrid mesh construction."""
+
+import glob
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.parallel import distributed, mesh as mesh_lib
+from howtotrainyourmamlpytorch_tpu.utils.profiling import StepTimer, maybe_trace
+
+
+def test_step_timer_stats():
+    t = StepTimer()
+    assert t.summary() == {}
+    for _ in range(4):
+        t.tick()
+    s = t.summary()
+    assert s["train_iters_per_sec"] > 0
+    assert s["train_step_time_min_ms"] <= s["train_step_time_ms"]
+    assert s["train_step_time_ms"] <= s["train_step_time_max_ms"]
+    t.reset()
+    assert t.summary() == {}
+
+
+def test_maybe_trace_disabled_is_noop():
+    with maybe_trace(None):
+        pass
+    with maybe_trace(""):
+        pass
+
+
+def test_maybe_trace_writes_profile(tmp_path):
+    import jax.numpy as jnp
+
+    with maybe_trace(str(tmp_path)):
+        jax.jit(lambda x: x * 2)(jnp.ones(8)).block_until_ready()
+    written = glob.glob(str(tmp_path / "**" / "*"), recursive=True)
+    assert written, "profiler produced no files"
+
+
+def test_hybrid_mesh_single_process():
+    m = distributed.hybrid_task_mesh()
+    assert m.axis_names == (distributed.DATA_AXIS, mesh_lib.TASK_AXIS)
+    assert m.devices.shape == (1, len(jax.devices()))
+
+
+def test_hybrid_mesh_simulated_hosts():
+    # simulate 2 hosts x 4 devices on the 8-device virtual CPU mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    m = distributed.hybrid_task_mesh(processes=2)
+    assert m.devices.shape == (2, 4)
+    # sharding a global batch over both axes: 8 tasks -> 1 per device
+    sharding = distributed.global_batch_sharding(m)
+    x = jax.device_put(np.arange(8.0), sharding)
+    assert len(x.addressable_shards) == 8
+    np.testing.assert_array_equal(np.asarray(x), np.arange(8.0))
+
+
+def test_initialize_distributed_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    assert distributed.initialize_distributed() is False

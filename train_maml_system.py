@@ -19,6 +19,7 @@ import sys
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig, _coerce_bool
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.parallel.distributed import initialize_distributed
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
 from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
 
@@ -60,8 +61,18 @@ def get_args(argv=None) -> MAMLConfig:
 
 def main(argv=None):
     cfg = get_args(argv)
+    initialize_distributed()  # no-op unless a multi-host coordinator is set
+    import jax
+
+    # dataset bootstrap: fail fast before paying model init; on pods only the
+    # primary extracts (shared DATASET_DIR), others wait at the barrier
+    if jax.process_index() == 0:
+        maybe_unzip_dataset(cfg)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dataset_bootstrap")
     model = MAMLFewShotClassifier(cfg)
-    maybe_unzip_dataset(cfg)  # ref train_maml_system.py:12
     builder = ExperimentBuilder(cfg, model, MetaLearningDataLoader)
     builder.run_experiment()
 
