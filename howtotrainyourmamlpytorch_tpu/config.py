@@ -105,6 +105,7 @@ class MAMLConfig:
     learnable_per_layer_per_step_inner_loop_learning_rate: bool = False
 
     # --- TPU-native knobs (new; no reference counterpart) ----------------
+    inner_loop_optimizer: str = "lslr"  # 'lslr' | 'sgd' (plain fixed-LR GD)
     compute_dtype: str = "float32"  # 'float32' | 'bfloat16' compute precision
     use_remat: bool = True  # jax.checkpoint the inner step (memory vs FLOPs)
     num_devices: int = 0  # 0 => use all visible devices for the task mesh
@@ -134,6 +135,21 @@ class MAMLConfig:
     def __post_init__(self) -> None:
         for f in dataclasses.fields(self):
             setattr(self, f.name, _coerce_bool(getattr(self, f.name)))
+        if self.inner_loop_optimizer not in ("lslr", "sgd"):
+            raise ValueError(
+                f"inner_loop_optimizer must be 'lslr' or 'sgd', got "
+                f"{self.inner_loop_optimizer!r}"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.compute_dtype!r}"
+            )
+        if self.norm_layer not in ("batch_norm", "layer_norm"):
+            raise ValueError(
+                f"norm_layer must be 'batch_norm' or 'layer_norm', got "
+                f"{self.norm_layer!r}"
+            )
         if os.environ.get("DATASET_DIR") and not os.path.isabs(self.dataset_path):
             # parser_utils.py:67-69 — dataset_path lives under $DATASET_DIR.
             self.dataset_path = os.path.join(

@@ -40,3 +40,19 @@ def update_params(
     return {
         key: weights[key] - lslr[key][num_step] * grads[key] for key in weights
     }
+
+
+def sgd_update_params(
+    weights: Dict[str, jnp.ndarray],
+    grads: Dict[str, jnp.ndarray],
+    learning_rate: float,
+) -> Dict[str, jnp.ndarray]:
+    """Plain fixed-LR gradient descent: theta' = theta - eta * g.
+
+    The reference's ``GradientDescentLearningRule.update_params``
+    (inner_loop_optimizers.py:39-52) — defined there but never used by the
+    main path (few_shot_learning_system.py:10 imports only LSLR); here it is
+    selectable via ``MAMLConfig.inner_loop_optimizer = "sgd"``. Equivalent to
+    LSLR with non-learnable LRs all equal to ``eta``.
+    """
+    return {key: weights[key] - learning_rate * grads[key] for key in weights}

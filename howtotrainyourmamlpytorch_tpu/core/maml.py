@@ -105,6 +105,7 @@ def make_optimizer(cfg: MAMLConfig, params: Dict[str, jnp.ndarray]):
             k: (
                 "train"
                 if cfg.learnable_per_layer_per_step_inner_loop_learning_rate
+                and cfg.inner_loop_optimizer != "sgd"
                 else "freeze"
             )
             for k in sorted(partition.split_inner(cfg, params)[0].keys())
@@ -140,7 +141,11 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
             # first-order MAML: cut the graph through the inner gradient
             # (ref: create_graph=False, few_shot_learning_system.py:138)
             grads = jax.tree_util.tree_map(jax.lax.stop_gradient, grads)
-        theta = lslr_lib.update_params(theta, grads, lslr_params, step)
+        if cfg.inner_loop_optimizer == "sgd":
+            # plain fixed-LR rule (inner_loop_optimizers.py:39-52)
+            theta = lslr_lib.sgd_update_params(theta, grads, cfg.inner_lr_init)
+        else:
+            theta = lslr_lib.update_params(theta, grads, lslr_params, step)
         # target loss with the *updated* weights at BN index `step`
         # (few_shot_learning_system.py:233-244)
         t_logits, new_bn = vgg.apply(

@@ -272,7 +272,7 @@ class ExperimentBuilder:
 
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
         per_model_preds: List[List[np.ndarray]] = [[] for _ in sorted_idx]
-        per_model_targets: List[List[np.ndarray]] = [[] for _ in sorted_idx]
+        all_targets: List[np.ndarray] = []
         for idx, model_idx in enumerate(sorted_idx):
             # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
             # (experiment_builder.py:265): epoch counter is 1-based at save
@@ -284,16 +284,23 @@ class ExperimentBuilder:
                 _, preds = self.model.run_validation_iter(
                     (x_s, x_t, y_s, y_t), return_preds=True
                 )
-                targets = self.model.gather_across_hosts(
-                    np.asarray(y_t).reshape(np.asarray(y_t).shape[0], -1)
-                )
                 per_model_preds[idx].extend(list(preds))
-                per_model_targets[idx].extend(list(targets))
+                if idx == 0:
+                    # the test stream is identical per call (fixed seed), so
+                    # targets only need gathering once, not once per model
+                    t = np.asarray(y_t)
+                    all_targets.extend(
+                        list(
+                            self.model.gather_across_hosts(
+                                t.reshape(t.shape[0], -1)
+                            )
+                        )
+                    )
 
         # ensemble: mean softmax over models -> argmax (:282-288)
         per_batch_preds = np.mean(np.array(per_model_preds), axis=0)
         per_batch_max = np.argmax(per_batch_preds, axis=2)
-        per_batch_targets = np.array(per_model_targets[0]).reshape(per_batch_max.shape)
+        per_batch_targets = np.array(all_targets).reshape(per_batch_max.shape)
         accuracy = float(np.mean(np.equal(per_batch_targets, per_batch_max)))
         accuracy_std = float(np.std(np.equal(per_batch_targets, per_batch_max)))
         test_losses = {

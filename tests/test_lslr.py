@@ -34,3 +34,39 @@ def test_update_only_touches_given_keys():
     out = lslr.update_params(weights, grads, lrs, 0)
     np.testing.assert_allclose(out["w"], 0.5)
     np.testing.assert_allclose(out["v"], 1.0)
+
+
+def test_sgd_update_math():
+    # theta' = theta - eta * g (inner_loop_optimizers.py:39-52)
+    weights = {"w": jnp.asarray([1.0, 2.0])}
+    grads = {"w": jnp.asarray([0.5, -1.0])}
+    out = lslr.sgd_update_params(weights, grads, 0.1)
+    np.testing.assert_allclose(out["w"], [0.95, 2.1], rtol=1e-6)
+
+
+def test_sgd_mode_equals_nonlearnable_lslr(tiny_cfg, synthetic_batch):
+    # fixed-LR GD == LSLR with all LRs at init (the reference's unused
+    # GradientDescentLearningRule vs LSLRGradientDescentLearningRule at init)
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
+
+    cfg_lslr = tiny_cfg.replace(
+        learnable_per_layer_per_step_inner_loop_learning_rate=False
+    )
+    cfg_sgd = cfg_lslr.replace(inner_loop_optimizer="sgd")
+    x_s, y_s, x_t, y_t = synthetic_batch(cfg_lslr)
+    w = jnp.asarray(
+        msl.final_step_only(cfg_lslr.number_of_training_steps_per_iter)
+    )
+    state = maml.init_state(cfg_lslr)
+    loss_a, grads_a = maml.make_grads_fn(cfg_lslr, second_order=True)(
+        state, x_s, y_s, x_t, y_t, w
+    )
+    loss_b, grads_b = maml.make_grads_fn(cfg_sgd, second_order=True)(
+        state, x_s, y_s, x_t, y_t, w
+    )
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for k in grads_a["net"]:
+        np.testing.assert_allclose(
+            np.asarray(grads_a["net"][k]), np.asarray(grads_b["net"][k]),
+            rtol=1e-5, atol=1e-6,
+        )

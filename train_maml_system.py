@@ -65,13 +65,34 @@ def main(argv=None):
     import jax
 
     # dataset bootstrap: fail fast before paying model init; on pods only the
-    # primary extracts (shared DATASET_DIR), others wait at the barrier
+    # primary extracts (shared DATASET_DIR). The outcome (incl. the
+    # cache-invalidation flag a re-extraction sets) is broadcast so non-primary
+    # hosts fail alongside the primary instead of hanging at a barrier, and so
+    # every host agrees on whether to rebuild the path-index cache.
+    bootstrap_err = None
     if jax.process_index() == 0:
-        maybe_unzip_dataset(cfg)
+        try:
+            maybe_unzip_dataset(cfg)
+        except Exception as exc:
+            bootstrap_err = exc
     if jax.process_count() > 1:
+        import numpy as np
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("dataset_bootstrap")
+        ok, reset = multihost_utils.broadcast_one_to_all(
+            np.array(
+                [bootstrap_err is None, cfg.reset_stored_filepaths], np.int32
+            )
+        )
+        cfg.reset_stored_filepaths = bool(reset)
+        if not ok:
+            raise (
+                bootstrap_err
+                if bootstrap_err is not None
+                else RuntimeError("dataset bootstrap failed on the primary host")
+            )
+    elif bootstrap_err is not None:
+        raise bootstrap_err
     model = MAMLFewShotClassifier(cfg)
     builder = ExperimentBuilder(cfg, model, MetaLearningDataLoader)
     builder.run_experiment()
