@@ -111,6 +111,7 @@ class MAMLConfig:
     num_devices: int = 0  # 0 => use all visible devices for the task mesh
     use_config_init_inner_lr: bool = False  # fix the task_learning_rate quirk
     cache_dir: str = ""  # where dataset path-index JSON caches go ('' => experiment dir)
+    use_mmap_cache: bool = False  # preprocessed uint8 memmap image cache (data/preprocess.py)
     prefetch_batches: int = 2  # host->device pipeline depth
     profile_trace_dir: str = ""  # jax profiler trace output ('' => disabled)
     profile_num_steps: int = 5  # train iterations captured in the trace

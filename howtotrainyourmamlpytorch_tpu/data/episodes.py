@@ -44,11 +44,16 @@ class Episode(NamedTuple):
     seed: int
 
 
-def load_image(cfg: MAMLConfig, image_path: str) -> np.ndarray:
-    """Decode one image to float32 HWC (data.py:374-395).
+def load_image_uint8(cfg: MAMLConfig, image_path: str) -> np.ndarray:
+    """Decode one image to its integer (pre-cast/pre-scale) uint8 HWC form.
 
-    Omniglot: LANCZOS resize, values left in [0, 255] (reference quirk).
-    Others: bilinear resize, RGB, /255.
+    The single home of the decode pipeline (reference data.py:374-395 up to
+    but not including the final dtype cast / 255-division, which
+    ``decode_cached`` applies): Omniglot is LANCZOS-resized (1-bit sources
+    decode to bool -> 0/1 uint8, the reference's unrescaled values); others
+    are resized + RGB-converted. Both the direct PIL path (``load_image``)
+    and the mmap cache (preprocess.py) decode through here, so they are
+    bit-identical by construction.
     """
     from PIL import Image
 
@@ -57,13 +62,42 @@ def load_image(cfg: MAMLConfig, image_path: str) -> np.ndarray:
         image = image.resize(
             (cfg.image_height, cfg.image_width), resample=Image.LANCZOS
         )
-        arr = np.array(image, np.float32)
+        arr = np.asarray(image)
+        if arr.dtype == bool:  # 1-bit PNGs decode to bool
+            arr = arr.astype(np.uint8)
         if cfg.image_channels == 1 and arr.ndim == 2:
             arr = arr[:, :, None]
     else:
         image = image.resize((cfg.image_height, cfg.image_width)).convert("RGB")
-        arr = np.array(image, np.float32) / 255.0
+        arr = np.asarray(image)
+    if arr.dtype != np.uint8:
+        raise ValueError(
+            f"{image_path!r} decodes to {arr.dtype}, not uint8 — only 8-bit "
+            f"(or 1-bit) sources are supported, like the reference's datasets"
+        )
     return arr
+
+
+def load_image(cfg: MAMLConfig, image_path: str) -> np.ndarray:
+    """Decode one image to float32 HWC (data.py:374-395).
+
+    Omniglot: LANCZOS resize, values left unrescaled (reference quirk).
+    Others: resize, RGB, /255.
+    """
+    return decode_cached(cfg, load_image_uint8(cfg, image_path))
+
+
+def decode_cached(cfg: MAMLConfig, arr: np.ndarray) -> np.ndarray:
+    """Finish decoding a uint8 cache entry to the reference's float values.
+
+    The mmap cache (preprocess.py) stores images in their integer form; the
+    reference's final step is a plain float32 cast for Omniglot (data.py:
+    383-387 — values stay in their integer range) and /255 for everything
+    else (:389-391).
+    """
+    if "omniglot" in cfg.dataset_name:
+        return arr.astype(np.float32)
+    return arr.astype(np.float32) / 255.0
 
 
 def augment_image(
@@ -134,6 +168,8 @@ def sample_episode(
         for si in sample_idx:
             if isinstance(store, np.ndarray):
                 img = store[si]
+                if img.dtype == np.uint8:  # mmap-cache entry: finish decode
+                    img = decode_cached(cfg, img)
             else:
                 img = load_image(cfg, store[si])
             imgs.append(

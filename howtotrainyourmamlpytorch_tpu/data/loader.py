@@ -46,7 +46,11 @@ class FewShotEpisodicDataset:
         self.seed = dict(self.init_seed)
         index, idx_to_label, label_to_idx = ds.load_class_index(cfg, cache_dir)
         self.splits = ds.split_classes(cfg, index, idx_to_label, self.seed["val"])
-        if cfg.load_into_memory:
+        if cfg.use_mmap_cache:
+            from .preprocess import build_mmap_cache
+
+            self.splits = build_mmap_cache(cfg, self.splits, cache_dir)
+        elif cfg.load_into_memory:
             self.splits = ds.preload_to_memory(cfg, self.splits)
         # class-key ordering per set is the dict insertion order — the
         # ordering rng.choice sees in the reference (data.py:486)

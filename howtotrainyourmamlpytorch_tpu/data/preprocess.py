@@ -1,0 +1,131 @@
+"""Preprocessed, memory-mapped uint8 image cache — the TPU-rate input path.
+
+The reference decodes every image with PIL at episode-sampling time
+(data.py:374-395), which would starve a TPU (SURVEY.md §7). Its only remedy
+is the full in-RAM float32 preload (data.py:213-230), which costs 4 bytes per
+subpixel of host RAM (≈5 GB for Mini-ImageNet at 84×84×3 × 60k images).
+
+This module decodes the dataset ONCE into a disk-backed uint8 memmap (¼ the
+RAM-preload footprint, shared between processes by the page cache) and serves
+per-class array views from it. Bit-exactness with the PIL path is preserved
+because both supported decode pipelines are integer-valued right up to their
+final cast:
+
+* Omniglot: ``Image.open(p).resize(LANCZOS)`` yields a binary/uint8 image;
+  the reference then casts to float32 WITHOUT rescaling (data.py:383-387), so
+  ``uint8 -> float32`` reproduces it exactly;
+* ImageNet-family: ``resize().convert("RGB")`` yields uint8 RGB; the
+  reference divides by 255 (data.py:389-391), so ``uint8 / 255`` reproduces
+  it exactly.
+
+Cache layout per (dataset, set, shape):
+  ``<cache_dir>/<dataset>_<set>_<h>x<w>x<c>.u8``    raw (n, h, w, c) uint8
+  ``<cache_dir>/<dataset>_<set>_<h>x<w>x<c>.json``  class order/counts + done flag
+
+The done flag is written only after the memmap is flushed, so a killed build
+is rebuilt, never served half-written. Multi-host runs should point
+``cache_dir`` at host-local storage or pre-build the cache once.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from ..config import MAMLConfig
+from .datasets import ClassIndex
+from .episodes import load_image_uint8
+
+# uint8 views are decoded per-sample in episodes.decode_cached; the shared
+# integer decode lives in episodes.load_image_uint8 so the PIL path and this
+# cache are bit-identical by construction
+
+
+def _cache_base(cfg: MAMLConfig, cache_dir: str, set_name: str) -> str:
+    h, w, c = cfg.im_shape
+    return os.path.join(
+        cache_dir, f"{cfg.dataset_name}_{set_name}_{h}x{w}x{c}"
+    )
+
+
+def build_set_cache(
+    cfg: MAMLConfig, classes: ClassIndex, cache_dir: str, set_name: str,
+    workers: int = 8,
+) -> Dict[str, np.ndarray]:
+    """Build (or reuse) one set's memmap cache; return class -> uint8 view.
+
+    Class order and per-class counts are recorded so a cache is only reused
+    when it matches the current split exactly.
+    """
+    base = _cache_base(cfg, cache_dir, set_name)
+    data_path, meta_path = base + ".u8", base + ".json"
+    h, w, c = cfg.im_shape
+    order: List[str] = list(classes.keys())
+    counts = [len(classes[k]) for k in order]
+    total = sum(counts)
+
+    meta = None
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    fresh = not (
+        meta
+        and meta.get("done")
+        and meta.get("classes") == order
+        and meta.get("counts") == counts
+        and os.path.exists(data_path)
+        and os.path.getsize(data_path) == total * h * w * c
+    )
+    if fresh:
+        os.makedirs(cache_dir, exist_ok=True)
+        # invalidate any stale meta BEFORE touching the data file: a rebuild
+        # killed mid-decode must never be servable under the old meta
+        if os.path.exists(meta_path):
+            os.remove(meta_path)
+        mm = np.memmap(
+            data_path, mode="w+", dtype=np.uint8, shape=(total, h, w, c)
+        )
+        jobs = []
+        offset = 0
+        for key, count in zip(order, counts):
+            for j, path in enumerate(classes[key]):
+                jobs.append((offset + j, path))
+            offset += count
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            for idx, arr in pool.map(
+                lambda job: (job[0], load_image_uint8(cfg, job[1])),
+                jobs,
+                chunksize=64,
+            ):
+                mm[idx] = arr
+        mm.flush()
+        del mm
+        with open(meta_path, "w") as f:
+            json.dump(
+                {"classes": order, "counts": counts, "done": True}, f
+            )
+
+    mm = np.memmap(data_path, mode="r", dtype=np.uint8, shape=(total, h, w, c))
+    views: Dict[str, np.ndarray] = {}
+    offset = 0
+    for key, count in zip(order, counts):
+        views[key] = mm[offset : offset + count]
+        offset += count
+    return views
+
+
+def build_mmap_cache(
+    cfg: MAMLConfig,
+    splits: Dict[str, ClassIndex],
+    cache_dir: str,
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Memmap-cache every set of the split (the drop-in alternative to
+    ``datasets.preload_to_memory``)."""
+    return {
+        set_name: build_set_cache(cfg, classes, cache_dir, set_name)
+        for set_name, classes in splits.items()
+    }

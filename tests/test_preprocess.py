@@ -1,0 +1,126 @@
+"""Memmap image-cache tests: bit-exactness vs the PIL path, reuse, rebuild."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.data import preprocess
+from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+
+
+def _write_dataset(root, n_classes, per_class, size, mode, seed=0):
+    """A tiny on-disk image dataset: <root>/<class>/<img>.png."""
+    rng = np.random.RandomState(seed)
+    for ci in range(n_classes):
+        d = os.path.join(root, f"class_{ci:02d}")
+        os.makedirs(d, exist_ok=True)
+        for j in range(per_class):
+            if mode == "1":  # omniglot-style 1-bit
+                arr = (rng.rand(size, size) > 0.5)
+                img = Image.fromarray(arr).convert("1")
+            else:  # RGB
+                arr = rng.randint(0, 256, (size, size, 3), np.uint8)
+                img = Image.fromarray(arr, "RGB")
+            img.save(os.path.join(d, f"im_{j}.png"))
+
+
+def _cfg(root, cache, **kw):
+    base = dict(
+        dataset_path=str(root),
+        cache_dir=str(cache),
+        indexes_of_folders_indicating_class=[-2],
+        train_val_test_split=[0.6, 0.2, 0.2],
+        num_classes_per_set=2,
+        num_samples_per_class=2,
+        num_target_samples=1,
+        batch_size=2,
+        num_dataprovider_workers=2,
+        load_into_memory=False,
+    )
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+def _first_batches(cfg, n=2):
+    loader = MetaLearningDataLoader(cfg, current_iter=0, cache_dir=cfg.cache_dir)
+    out = []
+    gen = loader.get_train_batches(total_batches=n)
+    for batch in gen:
+        out.append(batch)
+    out.append(next(iter(loader.get_val_batches(total_batches=1))))
+    return out
+
+
+@pytest.mark.parametrize(
+    "dataset_name,mode,h,c",
+    [
+        ("omniglot_dataset", "1", 12, 1),
+        ("mini_imagenet_full_size", "RGB", 16, 3),
+    ],
+)
+def test_mmap_cache_bit_exact_vs_pil_path(tmp_path, dataset_name, mode, h, c):
+    root = tmp_path / "data"
+    _write_dataset(str(root), n_classes=10, per_class=5, size=h, mode=mode)
+    common = dict(
+        dataset_name=dataset_name, image_height=h, image_width=h,
+        image_channels=c,
+    )
+    cfg_pil = _cfg(root, tmp_path / "c1", **common)
+    cfg_mm = _cfg(root, tmp_path / "c2", use_mmap_cache=True, **common)
+    for a, b in zip(_first_batches(cfg_pil), _first_batches(cfg_mm)):
+        for x, y in zip(a[:4], b[:4]):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_cache_files_reused_and_rebuilt_on_mismatch(tmp_path):
+    root = tmp_path / "data"
+    _write_dataset(str(root), n_classes=10, per_class=4, size=8, mode="1")
+    cfg = _cfg(
+        root, tmp_path / "cache", dataset_name="omniglot_dataset",
+        image_height=8, image_width=8, image_channels=1, use_mmap_cache=True,
+    )
+    b1 = _first_batches(cfg, n=1)
+    base = preprocess._cache_base(cfg, cfg.cache_dir, "train")
+    mtime = os.path.getmtime(base + ".u8")
+    # second build: reused, not rewritten
+    b2 = _first_batches(cfg, n=1)
+    assert os.path.getmtime(base + ".u8") == mtime
+    np.testing.assert_array_equal(b1[0][0], b2[0][0])
+    # corrupt the meta (simulate a split change): must rebuild
+    with open(base + ".json") as f:
+        meta = json.load(f)
+    good_counts = list(meta["counts"])
+    meta["counts"][0] += 1
+    with open(base + ".json", "w") as f:
+        json.dump(meta, f)
+    _first_batches(cfg, n=1)
+    with open(base + ".json") as f:
+        rebuilt = json.load(f)
+    assert rebuilt["counts"] == good_counts and rebuilt["done"]
+
+
+def test_half_written_cache_not_served(tmp_path):
+    """A build killed before the done flag is rebuilt from scratch."""
+    root = tmp_path / "data"
+    _write_dataset(str(root), n_classes=10, per_class=4, size=8, mode="1")
+    cfg = _cfg(
+        root, tmp_path / "cache", dataset_name="omniglot_dataset",
+        image_height=8, image_width=8, image_channels=1, use_mmap_cache=True,
+    )
+    b1 = _first_batches(cfg, n=1)
+    base = preprocess._cache_base(cfg, cfg.cache_dir, "train")
+    with open(base + ".json") as f:
+        meta = json.load(f)
+    meta["done"] = False
+    with open(base + ".json", "w") as f:
+        json.dump(meta, f)
+    # zero the data file to prove it is rebuilt, not trusted
+    size = os.path.getsize(base + ".u8")
+    with open(base + ".u8", "wb") as f:
+        f.write(b"\x00" * size)
+    b2 = _first_batches(cfg, n=1)
+    np.testing.assert_array_equal(b1[0][0], b2[0][0])
