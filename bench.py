@@ -35,6 +35,13 @@ def main() -> None:
                 "number_of_training_steps_per_iter"):
         if f"BENCH_{key.upper()}" in os.environ:
             overrides[key] = int(os.environ[f"BENCH_{key.upper()}"])
+    if "BENCH_COMPUTE_DTYPE" in os.environ:
+        overrides["compute_dtype"] = os.environ["BENCH_COMPUTE_DTYPE"]
+    if "BENCH_USE_REMAT" in os.environ:
+        raw = os.environ["BENCH_USE_REMAT"].lower()
+        if raw not in ("true", "false", "0", "1"):
+            raise SystemExit(f"BENCH_USE_REMAT must be a bool, got {raw!r}")
+        overrides["use_remat"] = raw in ("true", "1")
     # constant per-chip work: 8 tasks/chip unless overridden
     overrides.setdefault("batch_size", 8 * n_chips)
     cfg = _flagship_cfg(**overrides)

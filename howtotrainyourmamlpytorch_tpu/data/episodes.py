@@ -159,24 +159,41 @@ def sample_episode(
     k_list = rng.randint(0, 4, size=cfg.num_classes_per_set)
 
     spc, nts = cfg.num_samples_per_class, cfg.num_target_samples
+    # CIFAR's random crop/flip draws from the episode rng per image, so only
+    # the rng-free pipelines take the vectorized fast path
+    vectorizable = "cifar" not in cfg.dataset_name
     x_images = []
     y_labels = []
     for episode_label, class_key in enumerate(selected):
         store = classes[class_key]
         sample_idx = rng.choice(len(store), size=spc + nts, replace=False)
-        imgs = []
-        for si in sample_idx:
-            if isinstance(store, np.ndarray):
-                img = store[si]
-                if img.dtype == np.uint8:  # mmap-cache entry: finish decode
-                    img = decode_cached(cfg, img)
-            else:
-                img = load_image(cfg, store[si])
-            imgs.append(
-                augment_image(cfg, img, k=int(k_list[episode_label]),
-                              augment=augment, rng=rng)
-            )
-        x_images.append(np.stack(imgs))
+        k = int(k_list[episode_label])
+        if isinstance(store, np.ndarray) and vectorizable:
+            # fast path: one fancy-index gather + stack-level transform
+            # (numerically identical to the per-image path; the bit-exactness
+            # test pits this against the PIL pipeline)
+            imgs = store[sample_idx]
+            if imgs.dtype == np.uint8:  # mmap-cache entries: finish decode
+                imgs = decode_cached(cfg, imgs)
+            if "omniglot" in cfg.dataset_name:
+                if augment:
+                    imgs = np.rot90(imgs, k=k, axes=(1, 2))
+            elif "imagenet" in cfg.dataset_name:
+                imgs = (imgs - IMAGENET_MEAN) / IMAGENET_STD
+            x_images.append(np.ascontiguousarray(imgs))
+        else:
+            imgs = []
+            for si in sample_idx:
+                if isinstance(store, np.ndarray):
+                    img = store[si]
+                    if img.dtype == np.uint8:
+                        img = decode_cached(cfg, img)
+                else:
+                    img = load_image(cfg, store[si])
+                imgs.append(
+                    augment_image(cfg, img, k=k, augment=augment, rng=rng)
+                )
+            x_images.append(np.stack(imgs))
         y_labels.append(np.full(spc + nts, episode_label, np.int32))
 
     x = np.stack(x_images).astype(np.float32)  # (n, spc+nts, h, w, c)

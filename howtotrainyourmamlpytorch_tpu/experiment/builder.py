@@ -100,6 +100,7 @@ class ExperimentBuilder:
         # per-step timing as first-class metrics (SURVEY.md §5 — the
         # reference only records epoch_run_time)
         self.step_timer = StepTimer()
+        self._active_pbar = None
         self._tracing = False
         self._steps_this_run = 0
         # multi-host: checkpoint saves are collective (orbax), but metric
@@ -122,6 +123,33 @@ class ExperimentBuilder:
     def _log(self, msg: str):
         if self.verbose:
             print(msg, flush=True)
+
+    def _pbar(self, total: int, desc: str):
+        """A live tqdm progress bar with loss postfixes, mirroring the
+        reference's per-phase bars (experiment_builder.py:131-132,160-162,
+        184-186). Only on an interactive primary process — batch logs get the
+        per-epoch summary lines instead."""
+        if not (self.verbose and self.is_primary and sys.stderr.isatty()):
+            return None
+        try:
+            from tqdm import tqdm
+        except ImportError:  # optional dependency: degrade to summary lines
+            return None
+
+        return tqdm(total=total, desc=desc, leave=False)
+
+    @staticmethod
+    def _pbar_tick(pbar, summary: Dict[str, float], phase: str):
+        if pbar is None:
+            return
+        pbar.update(1)
+        pbar.set_postfix_str(
+            ", ".join(
+                f"{k.removeprefix(phase + '_')}: {v:.4f}"
+                for k, v in summary.items()
+                if k in (f"{phase}_loss_mean", f"{phase}_accuracy_mean")
+            )
+        )
 
     def _accumulate(self, losses: Dict[str, float], total_losses):
         for key, value in losses.items():
@@ -165,8 +193,16 @@ class ExperimentBuilder:
         total_losses: Dict[str, List[float]] = {}
         val_losses: Dict[str, float] = {}
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
-        for val_sample in self.data.get_val_batches(total_batches=n_batches):
-            val_losses = self.evaluation_iteration(val_sample, total_losses, "val")
+        pbar = self._pbar(n_batches, "val")
+        try:
+            for val_sample in self.data.get_val_batches(total_batches=n_batches):
+                val_losses = self.evaluation_iteration(
+                    val_sample, total_losses, "val"
+                )
+                self._pbar_tick(pbar, val_losses, "val")
+        finally:
+            if pbar is not None:
+                pbar.close()
         return val_losses
 
     def pack_and_save_metrics(self, train_losses, val_losses):
@@ -207,21 +243,39 @@ class ExperimentBuilder:
                 jax.profiler.stop_trace()
                 self._tracing = False
 
+    def _close_pbar(self):
+        if self._active_pbar is not None:
+            self._active_pbar.close()
+            self._active_pbar = None
+
     def _run_experiment(self):
         cfg = self.cfg
         total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
+        try:
+            return self._train_loop(cfg, total_iters)
+        finally:
+            self._close_pbar()
+
+    def _train_loop(self, cfg, total_iters):
         while (
             self.state["current_iter"] < total_iters
             and not cfg.evaluate_on_test_set_only
         ):
             remaining = total_iters - self.state["current_iter"]
+            self._active_pbar = self._pbar(
+                cfg.total_iter_per_epoch
+                - self.state["current_iter"] % cfg.total_iter_per_epoch,
+                f"train epoch {self.epoch}",
+            )
             for train_sample in self.data.get_train_batches(
                 total_batches=remaining, augment_images=self.augment_flag
             ):
                 epoch_idx = self.state["current_iter"] / cfg.total_iter_per_epoch
                 train_losses = self.train_iteration(train_sample, epoch_idx)
+                self._pbar_tick(self._active_pbar, train_losses, "train")
 
                 if self.state["current_iter"] % cfg.total_iter_per_epoch == 0:
+                    self._close_pbar()
                     val_losses = self.run_validation_epoch()
                     if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
                         self._log(
@@ -260,6 +314,11 @@ class ExperimentBuilder:
                             f"pause after {self.epochs_done_in_this_run} epochs"
                         )
                         sys.exit()
+                    if self.state["current_iter"] < total_iters:
+                        self._active_pbar = self._pbar(
+                            cfg.total_iter_per_epoch, f"train epoch {self.epoch}"
+                        )
+            self._close_pbar()
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
 
     # -- final test ensemble (experiment_builder.py:247-300) --------------
@@ -273,29 +332,13 @@ class ExperimentBuilder:
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
         per_model_preds: List[List[np.ndarray]] = [[] for _ in sorted_idx]
         all_targets: List[np.ndarray] = []
-        for idx, model_idx in enumerate(sorted_idx):
-            # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
-            # (experiment_builder.py:265): epoch counter is 1-based at save
-            self.state = self.model.load_model(
-                self.saved_models_filepath, int(model_idx) + 1
+        self._active_pbar = self._pbar(n_batches * len(sorted_idx), "test")
+        try:
+            self._ensemble_predict(
+                sorted_idx, n_batches, per_model_preds, all_targets
             )
-            for test_sample in self.data.get_test_batches(total_batches=n_batches):
-                x_s, x_t, y_s, y_t = test_sample[:4]
-                _, preds = self.model.run_validation_iter(
-                    (x_s, x_t, y_s, y_t), return_preds=True
-                )
-                per_model_preds[idx].extend(list(preds))
-                if idx == 0:
-                    # the test stream is identical per call (fixed seed), so
-                    # targets only need gathering once, not once per model
-                    t = np.asarray(y_t)
-                    all_targets.extend(
-                        list(
-                            self.model.gather_across_hosts(
-                                t.reshape(t.shape[0], -1)
-                            )
-                        )
-                    )
+        finally:
+            self._close_pbar()
 
         # ensemble: mean softmax over models -> argmax (:282-288)
         per_batch_preds = np.mean(np.array(per_model_preds), axis=0)
@@ -318,3 +361,30 @@ class ExperimentBuilder:
             )
         self._log(str(test_losses))
         return test_losses
+
+    def _ensemble_predict(self, sorted_idx, n_batches, per_model_preds, all_targets):
+        for idx, model_idx in enumerate(sorted_idx):
+            # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
+            # (experiment_builder.py:265): epoch counter is 1-based at save
+            self.state = self.model.load_model(
+                self.saved_models_filepath, int(model_idx) + 1
+            )
+            for test_sample in self.data.get_test_batches(total_batches=n_batches):
+                x_s, x_t, y_s, y_t = test_sample[:4]
+                _, preds = self.model.run_validation_iter(
+                    (x_s, x_t, y_s, y_t), return_preds=True
+                )
+                if self._active_pbar is not None:
+                    self._active_pbar.update(1)
+                per_model_preds[idx].extend(list(preds))
+                if idx == 0:
+                    # the test stream is identical per call (fixed seed), so
+                    # targets only need gathering once, not once per model
+                    t = np.asarray(y_t)
+                    all_targets.extend(
+                        list(
+                            self.model.gather_across_hosts(
+                                t.reshape(t.shape[0], -1)
+                            )
+                        )
+                    )

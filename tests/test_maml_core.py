@@ -37,6 +37,31 @@ def test_loss_drops_and_accuracy_rises(tiny_cfg, synthetic_batch):
     assert float(m["accuracy"]) > 0.6
 
 
+def test_bfloat16_compute_learns_and_tracks_f32(tiny_cfg, synthetic_batch):
+    """compute_dtype='bfloat16' (the MXU-native precision) must train: loss
+    finite and decreasing, params finite, and the first-step loss close to
+    f32's (params/grads stay f32 master copies; only activations are bf16)."""
+    cfg32 = tiny_cfg
+    cfg16 = tiny_cfg.replace(compute_dtype="bfloat16")
+    x_s, y_s, x_t, y_t = synthetic_batch(cfg32)
+    w = _weights(cfg32)
+    state32 = maml.init_state(cfg32)
+    state16 = maml.init_state(cfg16)
+    step32 = jax.jit(maml.make_train_step(cfg32, second_order=True))
+    step16 = jax.jit(maml.make_train_step(cfg16, second_order=True))
+    _, m32 = step32(state32, x_s, y_s, x_t, y_t, w, 0.001)
+    state16, m16 = step16(state16, x_s, y_s, x_t, y_t, w, 0.001)
+    assert abs(float(m32["loss"]) - float(m16["loss"])) < 0.05
+    m0 = m16
+    for _ in range(30):
+        state16, m16 = step16(state16, x_s, y_s, x_t, y_t, w, 0.001)
+    assert np.isfinite(float(m16["loss"]))
+    assert float(m16["loss"]) < float(m0["loss"])
+    for v in state16.net.values():
+        assert v.dtype == jnp.float32  # master params stay f32
+        assert bool(jnp.all(jnp.isfinite(v)))
+
+
 def test_second_order_grads_differ_from_first_order(tiny_cfg, synthetic_batch):
     """create_graph=True vs False must change the meta-update
     (few_shot_learning_system.py:138-139)."""
