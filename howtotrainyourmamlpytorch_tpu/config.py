@@ -84,6 +84,10 @@ class MAMLConfig:
     conv_padding: bool = True
     max_pooling: bool = False
     norm_layer: str = "batch_norm"  # 'batch_norm' | 'layer_norm'
+    # block op order: 'conv_norm_relu' is the reference's used block
+    # (MetaConvNormLayerReLU, meta_...py:323-436); 'norm_conv_relu' is its
+    # alternate (MetaNormLayerConvReLU, :438-542 — norm on block INPUT)
+    block_order: str = "conv_norm_relu"
     per_step_bn_statistics: bool = False
     learnable_bn_gamma: bool = True
     learnable_bn_beta: bool = True
@@ -115,6 +119,9 @@ class MAMLConfig:
     prefetch_batches: int = 2  # host->device pipeline depth
     profile_trace_dir: str = ""  # jax profiler trace output ('' => disabled)
     profile_num_steps: int = 5  # train iterations captured in the trace
+    # persistent XLA compilation cache: resumed runs skip the 20-40s TPU
+    # compile of the train/eval steps ('' => disabled)
+    compilation_cache_dir: str = ""
 
     # --- accepted-but-inert reference keys (SURVEY.md §5 "dead keys") ----
     dropout_rate_value: float = 0.0
@@ -150,6 +157,11 @@ class MAMLConfig:
             raise ValueError(
                 f"norm_layer must be 'batch_norm' or 'layer_norm', got "
                 f"{self.norm_layer!r}"
+            )
+        if self.block_order not in ("conv_norm_relu", "norm_conv_relu"):
+            raise ValueError(
+                f"block_order must be 'conv_norm_relu' or 'norm_conv_relu', "
+                f"got {self.block_order!r}"
             )
         if os.environ.get("DATASET_DIR") and not os.path.isabs(self.dataset_path):
             # parser_utils.py:67-69 — dataset_path lives under $DATASET_DIR.

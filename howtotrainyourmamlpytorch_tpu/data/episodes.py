@@ -100,6 +100,23 @@ def decode_cached(cfg: MAMLConfig, arr: np.ndarray) -> np.ndarray:
     return arr.astype(np.float32) / 255.0
 
 
+def augment_stack(
+    cfg: MAMLConfig, images: np.ndarray, k: int, augment: bool
+) -> np.ndarray:
+    """The rng-free transform rules on an (n, h, w, c) stack — the single
+    home of the omniglot/imagenet pipelines (data.py:55-108), shared by the
+    per-image path and the vectorized array-store fast path.
+    """
+    name = cfg.dataset_name
+    if "omniglot" in name:
+        if augment:
+            images = np.rot90(images, k=k, axes=(1, 2))
+        return np.ascontiguousarray(images)
+    if "imagenet" in name:
+        return (images - IMAGENET_MEAN) / IMAGENET_STD
+    return images
+
+
 def augment_image(
     cfg: MAMLConfig,
     image: np.ndarray,
@@ -114,25 +131,18 @@ def augment_image(
     train time, then mean/std normalize — the reference uses torchvision's
     global RNG for these; we use the episode RNG so tasks stay deterministic.
     """
-    name = cfg.dataset_name
-    if "omniglot" in name:
-        if augment:
-            image = np.rot90(image, k=k).copy()
-        return image
-    if "imagenet" in name:
-        return (image - IMAGENET_MEAN) / IMAGENET_STD
-    if "cifar" in name:
-        if augment and rng is not None:
-            padded = np.pad(image, ((4, 4), (4, 4), (0, 0)), mode="constant")
-            top = rng.randint(0, 9)
-            left = rng.randint(0, 9)
-            image = padded[top : top + 32, left : left + 32]
-            if rng.randint(0, 2):
-                image = image[:, ::-1].copy()
-        mean = np.asarray(getattr(cfg, "classification_mean", 0.5), np.float32)
-        std = np.asarray(getattr(cfg, "classification_std", 0.5), np.float32)
-        return (image - mean) / std
-    return image
+    if "cifar" not in cfg.dataset_name:
+        return augment_stack(cfg, image[None], k, augment)[0]
+    if augment and rng is not None:
+        padded = np.pad(image, ((4, 4), (4, 4), (0, 0)), mode="constant")
+        top = rng.randint(0, 9)
+        left = rng.randint(0, 9)
+        image = padded[top : top + 32, left : left + 32]
+        if rng.randint(0, 2):
+            image = image[:, ::-1].copy()
+    mean = np.asarray(getattr(cfg, "classification_mean", 0.5), np.float32)
+    std = np.asarray(getattr(cfg, "classification_std", 0.5), np.float32)
+    return (image - mean) / std
 
 
 InMemoryClass = np.ndarray  # (num_images, h, w, c)
@@ -169,18 +179,15 @@ def sample_episode(
         sample_idx = rng.choice(len(store), size=spc + nts, replace=False)
         k = int(k_list[episode_label])
         if isinstance(store, np.ndarray) and vectorizable:
-            # fast path: one fancy-index gather + stack-level transform
-            # (numerically identical to the per-image path; the bit-exactness
-            # test pits this against the PIL pipeline)
+            # fast path: one fancy-index gather + the shared stack-level
+            # transform (identical rules to the per-image path by
+            # construction — augment_image delegates to augment_stack)
             imgs = store[sample_idx]
             if imgs.dtype == np.uint8:  # mmap-cache entries: finish decode
                 imgs = decode_cached(cfg, imgs)
-            if "omniglot" in cfg.dataset_name:
-                if augment:
-                    imgs = np.rot90(imgs, k=k, axes=(1, 2))
-            elif "imagenet" in cfg.dataset_name:
-                imgs = (imgs - IMAGENET_MEAN) / IMAGENET_STD
-            x_images.append(np.ascontiguousarray(imgs))
+            x_images.append(
+                np.ascontiguousarray(augment_stack(cfg, imgs, k, augment))
+            )
         else:
             imgs = []
             for si in sample_idx:

@@ -330,12 +330,10 @@ class ExperimentBuilder:
         self._log(f"top-{top_n_models} val epochs {sorted_idx} acc {val_acc[sorted_idx]}")
 
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
-        per_model_preds: List[List[np.ndarray]] = [[] for _ in sorted_idx]
-        all_targets: List[np.ndarray] = []
         self._active_pbar = self._pbar(n_batches * len(sorted_idx), "test")
         try:
-            self._ensemble_predict(
-                sorted_idx, n_batches, per_model_preds, all_targets
+            per_model_preds, all_targets = self._ensemble_predict(
+                sorted_idx, n_batches
             )
         finally:
             self._close_pbar()
@@ -362,7 +360,12 @@ class ExperimentBuilder:
         self._log(str(test_losses))
         return test_losses
 
-    def _ensemble_predict(self, sorted_idx, n_batches, per_model_preds, all_targets):
+    def _ensemble_predict(self, sorted_idx, n_batches):
+        """Collect per-model softmax preds (and, once, the targets) over the
+        test stream for each top checkpoint. Loads each checkpoint into
+        ``self.model`` (reference experiment_builder.py:262-276)."""
+        per_model_preds: List[List[np.ndarray]] = [[] for _ in sorted_idx]
+        all_targets: List[np.ndarray] = []
         for idx, model_idx in enumerate(sorted_idx):
             # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
             # (experiment_builder.py:265): epoch counter is 1-based at save
@@ -388,3 +391,4 @@ class ExperimentBuilder:
                             )
                         )
                     )
+        return per_model_preds, all_targets

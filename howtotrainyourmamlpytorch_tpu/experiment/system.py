@@ -45,6 +45,14 @@ class MAMLFewShotClassifier:
 
     def __init__(self, cfg: MAMLConfig, use_mesh: bool = True):
         self.cfg = cfg
+        # persistent XLA compile cache: a resumed (kill-safe) run reuses the
+        # previous run's compiled train/eval steps. Always written (None
+        # disables) so a prior instance's setting never leaks into this one.
+        jax.config.update(
+            "jax_compilation_cache_dir", cfg.compilation_cache_dir or None
+        )
+        if cfg.compilation_cache_dir:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         self.current_epoch = 0
         self.state = maml.init_state(cfg)
         self.mesh = None

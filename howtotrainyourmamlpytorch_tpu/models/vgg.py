@@ -45,22 +45,34 @@ def _xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
     return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
 
 
-def _feature_hw(cfg: MAMLConfig) -> Tuple[int, int]:
-    """Spatial size after the conv stack (shape inference, replacing the
-    reference's dummy-tensor trace meta_...py:581-618)."""
+def _stage_dims(cfg: MAMLConfig):
+    """Per-stage spatial dims: yields (h_in, w_in, h_conv, w_conv, h_out,
+    w_out) for each stage — the single home of the conv/pool geometry (shape
+    inference, replacing the reference's dummy-tensor trace
+    meta_...py:581-618).
+    """
     h, w = cfg.image_height, cfg.image_width
     pad = 1 if cfg.conv_padding else 0
     for _ in range(cfg.num_stages):
         if cfg.max_pooling:
             # stride-1 conv then 2x2/2 maxpool (meta_...py:570,604-605)
-            h = h + 2 * pad - 2
-            w = w + 2 * pad - 2
-            h, w = h // 2, w // 2
+            ch, cw = h + 2 * pad - 2, w + 2 * pad - 2
+            oh, ow = ch // 2, cw // 2
         else:
             # stride-2 conv (meta_...py:573)
-            h = (h + 2 * pad - 3) // 2 + 1
-            w = (w + 2 * pad - 3) // 2 + 1
-    return h, w
+            ch = (h + 2 * pad - 3) // 2 + 1
+            cw = (w + 2 * pad - 3) // 2 + 1
+            oh, ow = ch, cw
+        yield h, w, ch, cw, oh, ow
+        h, w = oh, ow
+
+
+def _feature_hw(cfg: MAMLConfig) -> Tuple[int, int]:
+    """Spatial size after the conv stack."""
+    oh, ow = cfg.image_height, cfg.image_width
+    for _, _, _, _, oh, ow in _stage_dims(cfg):
+        pass
+    return oh, ow
 
 
 def feature_dim(cfg: MAMLConfig) -> int:
@@ -85,41 +97,36 @@ def init(cfg: MAMLConfig, key: jax.Array) -> Tuple[Params, BNState]:
     c_in = cfg.image_channels
     f = cfg.cnn_num_filters
     keys = jax.random.split(key, cfg.num_stages + 1)
+    conv_first = cfg.block_order == "conv_norm_relu"
 
-    ln_h, ln_w = cfg.image_height, cfg.image_width
-    pad = 1 if cfg.conv_padding else 0
-    for i in range(cfg.num_stages):
+    for i, (h, w, ch, cw, _, _) in enumerate(_stage_dims(cfg)):
         params[f"conv{i}.conv.weight"] = _xavier_uniform(
             keys[i], (3, 3, c_in, f), fan_in=c_in * 9, fan_out=f * 9
         )
         params[f"conv{i}.conv.bias"] = jnp.zeros((f,))
+        # norm features: the used block normalizes conv OUTPUT
+        # (MetaConvNormLayerReLU, meta_...py:356-385); the alternate block
+        # normalizes the block INPUT (MetaNormLayerConvReLU, :477-489)
+        nf = f if conv_first else c_in
         if cfg.norm_layer == "batch_norm":
             if cfg.per_step_bn_statistics and not cfg.enable_inner_loop_optimizable_bn_params:
                 # per-step gamma/beta (meta_...py:182-185)
-                params[f"conv{i}.norm.gamma"] = jnp.ones((steps, f))
-                params[f"conv{i}.norm.beta"] = jnp.zeros((steps, f))
+                params[f"conv{i}.norm.gamma"] = jnp.ones((steps, nf))
+                params[f"conv{i}.norm.beta"] = jnp.zeros((steps, nf))
             else:
                 # plain or inner-loop-adaptable scalars-per-feature
                 # (meta_...py:187-198)
-                params[f"conv{i}.norm.gamma"] = jnp.ones((f,))
-                params[f"conv{i}.norm.beta"] = jnp.zeros((f,))
+                params[f"conv{i}.norm.gamma"] = jnp.ones((nf,))
+                params[f"conv{i}.norm.beta"] = jnp.zeros((nf,))
             if cfg.per_step_bn_statistics:
-                bn_state[f"conv{i}.norm.mean"] = jnp.zeros((steps, f))
-                bn_state[f"conv{i}.norm.var"] = jnp.ones((steps, f))
-        elif cfg.norm_layer == "layer_norm":
-            # normalized over the full (h, w, c) post-conv feature shape
-            # (meta_...py:379: input_feature_shape=out.shape[1:])
-            if cfg.max_pooling:
-                ln_h, ln_w = ln_h + 2 * pad - 2, ln_w + 2 * pad - 2
-            else:
-                ln_h = (ln_h + 2 * pad - 3) // 2 + 1
-                ln_w = (ln_w + 2 * pad - 3) // 2 + 1
-            params[f"conv{i}.norm.gamma"] = jnp.ones((ln_h, ln_w, f))
-            params[f"conv{i}.norm.beta"] = jnp.zeros((ln_h, ln_w, f))
-            if cfg.max_pooling:
-                ln_h, ln_w = ln_h // 2, ln_w // 2
-        else:
-            raise ValueError(f"unknown norm_layer {cfg.norm_layer!r}")
+                bn_state[f"conv{i}.norm.mean"] = jnp.zeros((steps, nf))
+                bn_state[f"conv{i}.norm.var"] = jnp.ones((steps, nf))
+        else:  # layer_norm, validated at config build
+            # normalized over the full per-sample feature shape
+            # (meta_...py:379/:485: input_feature_shape=out.shape[1:])
+            lh, lw = (ch, cw) if conv_first else (h, w)
+            params[f"conv{i}.norm.gamma"] = jnp.ones((lh, lw, nf))
+            params[f"conv{i}.norm.beta"] = jnp.zeros((lh, lw, nf))
         c_in = f
 
     feat = feature_dim(cfg)
@@ -158,14 +165,9 @@ def apply(
     new_bn: BNState = {}
     step = jnp.clip(num_step, 0, cfg.bn_num_steps - 1)
 
-    for i in range(cfg.num_stages):
-        out = F.conv2d(
-            out,
-            params[f"conv{i}.conv.weight"],
-            params[f"conv{i}.conv.bias"],
-            stride=stride,
-            padding=pad,
-        )
+    conv_first = cfg.block_order == "conv_norm_relu"
+
+    def apply_norm(out, i):
         gamma = params[f"conv{i}.norm.gamma"]
         beta = params[f"conv{i}.norm.beta"]
         if cfg.norm_layer == "batch_norm":
@@ -186,6 +188,20 @@ def apply(
                 out, _, _ = F.batch_norm(out, gamma, beta, None, None)
         else:
             out = F.layer_norm(out, gamma, beta)
+        return out
+
+    for i in range(cfg.num_stages):
+        if not conv_first:  # alternate block: norm the INPUT (meta_...py:527-533)
+            out = apply_norm(out, i)
+        out = F.conv2d(
+            out,
+            params[f"conv{i}.conv.weight"],
+            params[f"conv{i}.conv.bias"],
+            stride=stride,
+            padding=pad,
+        )
+        if conv_first:
+            out = apply_norm(out, i)
         out = F.leaky_relu(out)
         if cfg.max_pooling:
             out = F.max_pool2d(out)

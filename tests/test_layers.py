@@ -101,6 +101,42 @@ def test_cross_entropy_matches_torch():
     assert abs(ours - theirs) < 1e-5
 
 
+def test_norm_conv_relu_block_order(tiny_cfg):
+    """The alternate norm-first block (MetaNormLayerConvReLU,
+    meta_...py:438-542): norm params sized to block INPUT channels, forward
+    runs, and a train step optimizes it."""
+    import jax
+    import jax.numpy as jnp
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
+    from howtotrainyourmamlpytorch_tpu.models import vgg
+
+    cfg = tiny_cfg.replace(block_order="norm_conv_relu")
+    params, bn_state = vgg.init(cfg, jax.random.PRNGKey(0))
+    # stage 0 normalizes the input image channels, not the conv output
+    assert params["conv0.norm.gamma"].shape[-1] == cfg.image_channels
+    assert params["conv1.norm.gamma"].shape[-1] == cfg.cnn_num_filters
+    x = np.random.RandomState(0).randn(6, *cfg.im_shape).astype(np.float32)
+    logits, new_bn = vgg.apply(cfg, params, bn_state, x, 0, training=True)
+    assert logits.shape == (6, cfg.num_classes_per_set)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+    state = maml.init_state(cfg)
+    w = jnp.asarray(
+        msl.final_step_only(cfg.number_of_training_steps_per_iter)
+    )
+    rng = np.random.RandomState(0)
+    b, n = cfg.batch_size, cfg.num_classes_per_set
+    s, t = cfg.num_samples_per_class, cfg.num_target_samples
+    h, ww, c = cfg.im_shape
+    x_s = rng.randn(b, n, s, h, ww, c).astype(np.float32)
+    x_t = rng.randn(b, n, t, h, ww, c).astype(np.float32)
+    y_s = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, s))
+    y_t = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, t))
+    step = jax.jit(maml.make_train_step(cfg, second_order=True))
+    new_state, metrics = step(state, x_s, y_s, x_t, y_t, w, 0.001)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_leaky_relu_default_slope():
     x = np.array([-2.0, -0.5, 0.0, 3.0], np.float32)
     ours = np.asarray(F.leaky_relu(x))
