@@ -201,4 +201,11 @@ def main(root: str = ".") -> List[str]:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--output_root", default=".",
+        help="directory receiving experiment_config/ and experiment_scripts/",
+    )
+    main(ap.parse_args().output_root)

@@ -68,22 +68,22 @@ def tiny_cfg() -> MAMLConfig:
     )
 
 
+def make_synthetic_batch(cfg: MAMLConfig, batch_size=None, seed=0):
+    """A deterministic synthetic task batch, NHWC, (x_s, y_s, x_t, y_t)."""
+    rng = np.random.RandomState(seed)
+    b = batch_size or cfg.batch_size
+    n = cfg.num_classes_per_set
+    s, t = cfg.num_samples_per_class, cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    # class-dependent means so tasks are learnable
+    means = rng.randn(b, n, 1, 1, 1, 1).astype(np.float32)
+    x_s = rng.randn(b, n, s, h, w, c).astype(np.float32) * 0.1 + means
+    x_t = rng.randn(b, n, t, h, w, c).astype(np.float32) * 0.1 + means
+    y_s = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, s))
+    y_t = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, t))
+    return x_s, y_s, x_t, y_t
+
+
 @pytest.fixture
 def synthetic_batch():
-    """A deterministic synthetic task batch, NHWC."""
-
-    def make(cfg: MAMLConfig, batch_size=None, seed=0):
-        rng = np.random.RandomState(seed)
-        b = batch_size or cfg.batch_size
-        n = cfg.num_classes_per_set
-        s, t = cfg.num_samples_per_class, cfg.num_target_samples
-        h, w, c = cfg.im_shape
-        # class-dependent means so tasks are learnable
-        means = rng.randn(b, n, 1, 1, 1, 1).astype(np.float32)
-        x_s = rng.randn(b, n, s, h, w, c).astype(np.float32) * 0.1 + means
-        x_t = rng.randn(b, n, t, h, w, c).astype(np.float32) * 0.1 + means
-        y_s = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, s))
-        y_t = np.tile(np.arange(n, dtype=np.int32)[None, :, None], (b, 1, t))
-        return x_s, y_s, x_t, y_t
-
-    return make
+    return make_synthetic_batch

@@ -1,0 +1,89 @@
+"""System-facade tests: per-iteration host logic of MAMLFewShotClassifier
+(few_shot_learning_system.py:296-397 equivalents) — LR schedule, MSL logging,
+first->second-order switch, layout conversion."""
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.core import maml, msl
+from howtotrainyourmamlpytorch_tpu.experiment.system import (
+    MAMLFewShotClassifier,
+    _to_nhwc,
+)
+
+
+def _batch(cfg, seed=0):
+    """The conftest synthetic batch, reordered to the facade's data-batch
+    convention (x_s, x_t, y_s, y_t — reference few_shot_learning_system.py:
+    355-358)."""
+    from conftest import make_synthetic_batch
+
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=seed)
+    return x_s, x_t, y_s, y_t
+
+
+def test_losses_dict_has_reference_keys(tiny_cfg):
+    model = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    losses = model.run_train_iter(_batch(tiny_cfg), epoch=0)
+    assert "loss" in losses and "accuracy" in losses
+    assert losses["learning_rate"] == pytest.approx(maml.cosine_lr(tiny_cfg, 0))
+    # per-step MSL weights logged each iteration (ref :260-262)
+    n_steps = tiny_cfg.number_of_training_steps_per_iter
+    expected = msl.per_step_loss_importance(
+        n_steps, tiny_cfg.multi_step_loss_num_epochs, 0
+    )
+    for i in range(n_steps):
+        assert losses[f"loss_importance_vector_{i}"] == pytest.approx(
+            float(expected[i])
+        )
+
+
+def test_cosine_lr_follows_epoch(tiny_cfg):
+    model = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    batch = _batch(tiny_cfg)
+    l0 = model.run_train_iter(batch, epoch=0)
+    l3 = model.run_train_iter(batch, epoch=3)
+    assert l0["learning_rate"] == pytest.approx(maml.cosine_lr(tiny_cfg, 0))
+    assert l3["learning_rate"] == pytest.approx(maml.cosine_lr(tiny_cfg, 3))
+    assert l3["learning_rate"] < l0["learning_rate"]
+
+
+def test_first_to_second_order_switch(tiny_cfg):
+    """epoch > first_order_to_second_order_epoch selects the second-order
+    compile (ref :304-305)."""
+    cfg = tiny_cfg.replace(second_order=True, first_order_to_second_order_epoch=1)
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    batch = _batch(cfg)
+    model.run_train_iter(batch, epoch=0)
+    assert set(model._train_steps) == {False}
+    model.run_train_iter(batch, epoch=1)  # not yet: 1 > 1 is False
+    assert set(model._train_steps) == {False}
+    model.run_train_iter(batch, epoch=2)
+    assert set(model._train_steps) == {False, True}
+
+
+def test_second_order_false_never_compiles_second_order(tiny_cfg):
+    cfg = tiny_cfg.replace(second_order=False, first_order_to_second_order_epoch=-1)
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    model.run_train_iter(_batch(cfg), epoch=5)
+    assert set(model._train_steps) == {False}
+
+
+def test_to_nhwc_accepts_both_layouts(tiny_cfg):
+    h, w = 14, 14
+    nchw = np.zeros((2, 3, 1, h, w), np.float32)  # (..., c, h, w)
+    nhwc = np.zeros((2, 3, h, w, 1), np.float32)
+    assert _to_nhwc(nchw).shape == (2, 3, h, w, 1)
+    assert _to_nhwc(nhwc).shape == (2, 3, h, w, 1)
+    with pytest.raises(ValueError):
+        _to_nhwc(np.zeros((2, 3, 5, 7, 9), np.float32))
+
+
+def test_validation_iter_returns_preds_only_on_request(tiny_cfg):
+    model = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    losses, preds = model.run_validation_iter(_batch(tiny_cfg))
+    assert preds is None and "accuracy" in losses
+    losses, preds = model.run_validation_iter(_batch(tiny_cfg), return_preds=True)
+    b = tiny_cfg.batch_size
+    n, t = tiny_cfg.num_classes_per_set, tiny_cfg.num_target_samples
+    assert preds.shape == (b, n * t, n)
