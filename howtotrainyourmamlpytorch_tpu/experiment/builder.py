@@ -113,11 +113,16 @@ class ExperimentBuilder:
 
     @staticmethod
     def build_summary_dict(total_losses, phase, summary_losses=None):
+        """Per-phase mean/std of every accumulated metric. Values may be
+        device arrays (the per-step metrics are left unconverted so the train
+        loop never blocks on device->host sync); the np.asarray here is the
+        one synchronization point, at summary time."""
         if summary_losses is None:
             summary_losses = {}
         for key in total_losses:
-            summary_losses[f"{phase}_{key}_mean"] = float(np.mean(total_losses[key]))
-            summary_losses[f"{phase}_{key}_std"] = float(np.std(total_losses[key]))
+            vals = np.asarray([np.asarray(v) for v in total_losses[key]])
+            summary_losses[f"{phase}_{key}_mean"] = float(np.mean(vals))
+            summary_losses[f"{phase}_{key}_std"] = float(np.std(vals))
         return summary_losses
 
     def _log(self, msg: str):
@@ -152,8 +157,9 @@ class ExperimentBuilder:
         )
 
     def _accumulate(self, losses: Dict[str, float], total_losses):
+        # values may be device arrays; conversion is deferred to summary time
         for key, value in losses.items():
-            total_losses.setdefault(key, []).append(float(value))
+            total_losses.setdefault(key, []).append(value)
 
     # -- phases -----------------------------------------------------------
 
@@ -163,9 +169,10 @@ class ExperimentBuilder:
         losses = self.model.run_train_iter((x_s, x_t, y_s, y_t), epoch=epoch_idx)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += 1
+        # with the model's one-step-lag sync, tick intervals equal device
+        # step time at steady state (one step in flight, host waits on k-1)
         self.step_timer.tick()
         self._steps_this_run += 1
-        return self.build_summary_dict(self.total_losses, "train")
 
     def _maybe_profile_step(self):
         """Capture a jax profiler trace of train iterations
@@ -180,30 +187,32 @@ class ExperimentBuilder:
             jax.profiler.start_trace(cfg.profile_trace_dir)
             self._tracing = True
         elif self._tracing and self._steps_this_run >= 1 + cfg.profile_num_steps:
+            # steps are dispatched asynchronously — drain the device before
+            # stopping so the trace actually contains the profiled steps
+            jax.block_until_ready(self.model.state.net)
             jax.profiler.stop_trace()
             self._tracing = False
 
-    def evaluation_iteration(self, val_sample, total_losses, phase: str):
+    def evaluation_iteration(self, val_sample, total_losses):
         x_s, x_t, y_s, y_t = val_sample[:4]
         losses, _ = self.model.run_validation_iter((x_s, x_t, y_s, y_t))
         self._accumulate(losses, total_losses)
-        return self.build_summary_dict(total_losses, phase)
 
     def run_validation_epoch(self) -> Dict[str, float]:
         total_losses: Dict[str, List[float]] = {}
-        val_losses: Dict[str, float] = {}
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
         pbar = self._pbar(n_batches, "val")
         try:
             for val_sample in self.data.get_val_batches(total_batches=n_batches):
-                val_losses = self.evaluation_iteration(
-                    val_sample, total_losses, "val"
-                )
-                self._pbar_tick(pbar, val_losses, "val")
+                self.evaluation_iteration(val_sample, total_losses)
+                if pbar is not None:  # interactive: pay the sync for liveness
+                    self._pbar_tick(
+                        pbar, self.build_summary_dict(total_losses, "val"), "val"
+                    )
         finally:
             if pbar is not None:
                 pbar.close()
-        return val_losses
+        return self.build_summary_dict(total_losses, "val")
 
     def pack_and_save_metrics(self, train_losses, val_losses):
         """Per-epoch CSV/JSON metric rows (experiment_builder.py:208-245),
@@ -240,6 +249,7 @@ class ExperimentBuilder:
             if self._tracing:
                 import jax
 
+                jax.block_until_ready(self.model.state.net)
                 jax.profiler.stop_trace()
                 self._tracing = False
 
@@ -271,11 +281,21 @@ class ExperimentBuilder:
                 total_batches=remaining, augment_images=self.augment_flag
             ):
                 epoch_idx = self.state["current_iter"] / cfg.total_iter_per_epoch
-                train_losses = self.train_iteration(train_sample, epoch_idx)
-                self._pbar_tick(self._active_pbar, train_losses, "train")
+                self.train_iteration(train_sample, epoch_idx)
+                if self._active_pbar is not None:
+                    # interactive: pay the device sync for live numbers;
+                    # batch runs stay fully pipelined (no per-step sync)
+                    self._pbar_tick(
+                        self._active_pbar,
+                        self.build_summary_dict(self.total_losses, "train"),
+                        "train",
+                    )
 
                 if self.state["current_iter"] % cfg.total_iter_per_epoch == 0:
                     self._close_pbar()
+                    train_losses = self.build_summary_dict(
+                        self.total_losses, "train"
+                    )
                     val_losses = self.run_validation_epoch()
                     if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
                         self._log(

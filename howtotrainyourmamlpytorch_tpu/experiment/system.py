@@ -85,6 +85,10 @@ class MAMLFewShotClassifier:
                 self.state = mesh_lib.replicate_state(self.mesh, self.state)
         self._train_steps: Dict[bool, Any] = {}
         self._eval_step = jax.jit(maml.make_eval_step(cfg))
+        # 1-step-lag sync handle: bounds device run-ahead to one in-flight
+        # step (backpressure against queued-input OOM) while still
+        # overlapping host work with device compute
+        self._pending_sync = None
 
     # -- step selection ---------------------------------------------------
 
@@ -126,10 +130,12 @@ class MAMLFewShotClassifier:
 
     # -- public API (reference-shaped) ------------------------------------
 
-    def run_train_iter(self, data_batch, epoch) -> Dict[str, float]:
+    def run_train_iter(self, data_batch, epoch) -> Dict[str, Any]:
         """One outer-loop update (ref :338-369). Returns the losses dict with
         the reference's keys (loss, accuracy, loss_importance_vector_i,
-        learning_rate)."""
+        learning_rate). loss/accuracy are DEVICE arrays (convert at summary
+        time — per-step float() would serialize the pipeline); the schedule
+        entries are host floats."""
         epoch = int(epoch)
         self.current_epoch = epoch
         cfg = self.cfg
@@ -145,10 +151,20 @@ class MAMLFewShotClassifier:
             cfg.second_order and epoch > cfg.first_order_to_second_order_epoch
         )
         x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
+        # wait for the PREVIOUS step before enqueuing the next: a one-step
+        # pipeline. (Zero sync would let the host run an epoch ahead, pinning
+        # every queued input batch in device memory; per-step float() would
+        # serialize host and device completely.)
+        if self._pending_sync is not None:
+            jax.block_until_ready(self._pending_sync)
         self.state, metrics = self._train_step(second_order)(
             self.state, x_s, y_s, x_t, y_t, weights, lr
         )
-        losses = {k: float(v) for k, v in metrics.items()}
+        self._pending_sync = metrics["loss"]
+        # metrics stay device arrays — the float() happens when the builder
+        # summarizes an epoch; through a networked device transport every
+        # forced per-step sync would be a round-trip
+        losses = dict(metrics)
         # per-step MSL weights logged each iteration (ref :260-262)
         anneal = msl.per_step_loss_importance(
             cfg.number_of_training_steps_per_iter,
@@ -162,15 +178,20 @@ class MAMLFewShotClassifier:
 
     def run_validation_iter(
         self, data_batch, return_preds: bool = False
-    ) -> Tuple[Dict[str, float], Optional[np.ndarray]]:
-        """One evaluation pass (ref :371-397). Returns (losses, preds).
+    ) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
+        """One evaluation pass (ref :371-397). Returns (losses, preds);
+        losses values are device arrays (see run_train_iter).
 
         ``return_preds=True`` materialises the per-task softmax predictions
         on the host (cross-host allgather in multihost mode) — only the test
         ensemble needs them; plain validation skips the transfer entirely.
         """
         x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
+        if self._pending_sync is not None:  # same one-step pipeline as train
+            jax.block_until_ready(self._pending_sync)
         metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
+        self._pending_sync = metrics["loss"]
+        metrics = dict(metrics)  # device arrays; caller converts on summary
         out_preds = None
         if return_preds:
             if self.multihost:
@@ -180,7 +201,7 @@ class MAMLFewShotClassifier:
 
                 preds = multihost_utils.process_allgather(preds, tiled=True)
             out_preds = np.asarray(preds)
-        return {k: float(v) for k, v in metrics.items()}, out_preds
+        return metrics, out_preds
 
     def gather_across_hosts(self, a: np.ndarray) -> np.ndarray:
         """Concatenate per-host arrays along axis 0 (identity single-host).
