@@ -1,0 +1,5 @@
+#!/bin/sh
+# TPU launch script (generated). Usage: ./mini-imagenet_maml++-tpu_large_batch_256_few_shot.sh [extra CLI overrides]
+cd "$(dirname "$0")/.."
+export DATASET_DIR="${DATASET_DIR:-datasets/}"
+python train_maml_system.py --name_of_args_json_file experiment_config/mini-imagenet_maml++-tpu_large_batch_256.json "$@"

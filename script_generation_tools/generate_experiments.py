@@ -138,12 +138,33 @@ def grid_points(spec: Dict[str, List]) -> List[Dict]:
     return points
 
 
+def write_experiment(cfg_dir: str, script_dir: str, stem: str, fields: Dict) -> str:
+    """Schema-check one experiment and write its config JSON + launch script."""
+    unknown = set(fields) - MAMLConfig.known_keys()
+    assert not unknown, f"unknown config keys: {unknown}"
+    cfg = MAMLConfig(**fields)  # schema check
+    cfg_path = os.path.join(cfg_dir, stem + ".json")
+    with open(cfg_path, "w") as f:
+        json.dump(
+            {k: v for k, v in dataclasses.asdict(cfg).items() if k in fields},
+            f, indent=2, sort_keys=True,
+        )
+    script_name = stem + "_few_shot.sh"
+    script_path = os.path.join(script_dir, script_name)
+    with open(script_path, "w") as f:
+        f.write(SCRIPT_TEMPLATE.format(name=script_name, config=stem + ".json"))
+    os.chmod(
+        script_path,
+        os.stat(script_path).st_mode | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH,
+    )
+    return cfg_path
+
+
 def main(root: str = ".") -> List[str]:
     cfg_dir = os.path.join(root, "experiment_config")
     script_dir = os.path.join(root, "experiment_scripts")
     os.makedirs(cfg_dir, exist_ok=True)
     os.makedirs(script_dir, exist_ok=True)
-    known = MAMLConfig.known_keys()
     written = []
     for seed in SEEDS:
         for ds_name, spec in GRID.items():
@@ -169,33 +190,40 @@ def main(root: str = ".") -> List[str]:
                         task_learning_rate=point["init_inner_loop_learning_rate"],
                         cnn_num_filters=point["num_filters"],
                     )
-                    unknown = set(fields) - known
-                    assert not unknown, f"unknown config keys: {unknown}"
-                    cfg = MAMLConfig(**fields)  # schema check
                     stem = f"{ds_name}_{algo}-{experiment_name}"
-                    cfg_path = os.path.join(cfg_dir, stem + ".json")
-                    with open(cfg_path, "w") as f:
-                        json.dump(
-                            {
-                                k: v for k, v in dataclasses.asdict(cfg).items()
-                                if k in fields
-                            },
-                            f, indent=2, sort_keys=True,
-                        )
-                    script_name = stem + "_few_shot.sh"
-                    script_path = os.path.join(script_dir, script_name)
-                    with open(script_path, "w") as f:
-                        f.write(
-                            SCRIPT_TEMPLATE.format(
-                                name=script_name, config=stem + ".json"
-                            )
-                        )
-                    os.chmod(
-                        script_path,
-                        os.stat(script_path).st_mode
-                        | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH,
+                    written.append(
+                        write_experiment(cfg_dir, script_dir, stem, fields)
                     )
-                    written.append(cfg_path)
+
+    # TPU-scale extra (beyond the reference's 36-point grid): the
+    # large-meta-batch pod config from BASELINE.json — >=256 tasks sharded
+    # over the chip mesh, mmap-cached input path
+    fields = dict(SHARED)
+    fields.update(DATASET_BASE["mini-imagenet"])
+    fields.update(ALGO_FLAGS["maml++"])
+    fields.update(
+        # experiment_name == file stem, preserving the grid's 1:1 mapping of
+        # config file to experiment logs folder
+        experiment_name="mini-imagenet_maml++-tpu_large_batch_256",
+        train_seed=0,
+        batch_size=256,
+        num_classes_per_set=5,
+        num_samples_per_class=5,
+        init_inner_loop_learning_rate=0.01,
+        task_learning_rate=0.01,
+        cnn_num_filters=48,
+        load_into_memory=False,
+        use_mmap_cache=True,
+        # divisible by the 256-task meta-batch (600 would silently truncate
+        # to 512 evaluated tasks)
+        num_evaluation_tasks=512,
+    )
+    written.append(
+        write_experiment(
+            cfg_dir, script_dir, "mini-imagenet_maml++-tpu_large_batch_256",
+            fields,
+        )
+    )
     print(f"wrote {len(written)} configs to {cfg_dir} (+ scripts)")
     return written
 

@@ -21,9 +21,14 @@ def test_generator_produces_full_grid(tmp_path):
     configs = sorted(os.listdir(tmp_path / "experiment_config"))
     scripts = sorted(os.listdir(tmp_path / "experiment_scripts"))
     # 3 seeds x (omniglot spc{1,5} x way{20,5} + mini-imagenet spc{1,5}) x
-    # {maml, maml++} = 36 (generate_configs.py:30-36 grid)
-    assert len(configs) == 36
-    assert len(scripts) == 36
+    # {maml, maml++} = 36 (generate_configs.py:30-36 grid), plus the
+    # TPU large-meta-batch extra
+    assert len(configs) == 37
+    assert len(scripts) == 37
+    large = [n for n in configs if "large_batch" in n]
+    assert len(large) == 1
+    lb = MAMLConfig.from_json_file(str(tmp_path / "experiment_config" / large[0]))
+    assert lb.batch_size == 256 and lb.use_mmap_cache
     # every config loads through the typed schema and round-trips key fields
     for name in configs:
         cfg = MAMLConfig.from_json_file(str(tmp_path / "experiment_config" / name))
@@ -47,7 +52,7 @@ def test_checked_in_configs_match_schema():
     JSONs are the user-facing interface)."""
     cfg_dir = os.path.join(REPO, "experiment_config")
     names = [n for n in os.listdir(cfg_dir) if n.endswith(".json")]
-    assert len(names) == 36
+    assert len(names) == 37  # reference's 36-point grid + TPU large-batch
     for name in names:
         cfg = MAMLConfig.from_json_file(os.path.join(cfg_dir, name))
         assert cfg.num_classes_per_set in (5, 20)

@@ -70,6 +70,30 @@ def test_sharded_step_matches_single_device(tiny_cfg, synthetic_batch):
     )
 
 
+def test_large_meta_batch_256_tasks(tiny_cfg, synthetic_batch):
+    """The large-meta-batch capability (BASELINE.json: '>=256 tasks across
+    the mesh'): one second-order MAML++ step with 256 tasks sharded over the
+    8-device mesh compiles and executes (tiny shapes keep CPU runtime sane)."""
+    cfg = tiny_cfg.replace(
+        batch_size=256,
+        image_height=8,
+        image_width=8,
+        cnn_num_filters=4,
+        num_stages=2,
+        use_remat=True,
+    )
+    state = maml.init_state(cfg)
+    x_s, y_s, x_t, y_t = synthetic_batch(cfg)
+    w = _weights(cfg)
+    mesh = mesh_lib.task_mesh(8)
+    state = mesh_lib.replicate_state(mesh, state)
+    xs, ys, xt, yt = mesh_lib.shard_batch(mesh, x_s, y_s, x_t, y_t)
+    step = jax.jit(maml.make_train_step(cfg, second_order=True))
+    new_state, metrics = step(state, xs, ys, xt, yt, w, 0.001)
+    assert np.isfinite(float(metrics["loss"]))
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+
 def test_mesh_requires_divisible_batch():
     mesh = mesh_lib.task_mesh(8)
     with pytest.raises(ValueError, match="not divisible"):
