@@ -1,0 +1,75 @@
+"""Throughput sweep over the TPU-native perf knobs.
+
+Runs ``bench.py`` (fresh process per point, so each gets a clean XLA
+compilation environment) across {compute_dtype} x {use_remat} and prints a
+ranked table plus the best point's env settings. Use on real TPU hardware to
+pick the flagship bench configuration.
+
+    python script_generation_tools/bench_sweep.py [--steps 20] [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_point(env_overrides: dict, timeout: int) -> dict:
+    env = dict(os.environ, **{k: str(v) for k, v in env_overrides.items()})
+    try:
+        out = subprocess.run(
+            [sys.executable, "bench.py"], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        # one slow point must not discard the rest of the sweep
+        return {"error": f"timeout after {timeout}s"}
+    if out.returncode != 0:
+        return {"error": out.stderr.strip().splitlines()[-1] if out.stderr else "?"}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=20, help="timed steps per point")
+    ap.add_argument("--batch", type=int, default=0, help="meta-batch override (0 = bench default)")
+    ap.add_argument("--timeout", type=int, default=900, help="per-point timeout (s)")
+    args = ap.parse_args()
+
+    points = []
+    for dtype in ("float32", "bfloat16"):
+        for remat in ("true", "false"):
+            ov = {
+                "BENCH_COMPUTE_DTYPE": dtype,
+                "BENCH_USE_REMAT": remat,
+                "BENCH_TIMED_STEPS": args.steps,
+            }
+            if args.batch:
+                ov["BENCH_BATCH_SIZE"] = args.batch
+            print(f"... dtype={dtype} remat={remat}", flush=True)
+            res = run_point(ov, args.timeout)
+            points.append((dtype, remat, res))
+
+    ok = [(d, r, x) for d, r, x in points if "value" in x]
+    ok.sort(key=lambda p: -p[2]["value"])
+    print(f"\n{'dtype':<10} {'remat':<6} {'tasks/s/chip':>13}")
+    for d, r, x in ok:
+        print(f"{d:<10} {r:<6} {x['value']:>13.3f}")
+    for d, r, x in points:
+        if "error" in x:
+            print(f"{d:<10} {r:<6} ERROR: {x['error']}")
+    if ok:
+        d, r, x = ok[0]
+        print(
+            f"\nbest: BENCH_COMPUTE_DTYPE={d} BENCH_USE_REMAT={r} "
+            f"-> {x['value']} {x['unit']}"
+        )
+
+
+if __name__ == "__main__":
+    main()

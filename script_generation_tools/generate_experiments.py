@@ -201,10 +201,11 @@ def main(root: str = ".") -> List[str]:
     fields = dict(SHARED)
     fields.update(DATASET_BASE["mini-imagenet"])
     fields.update(ALGO_FLAGS["maml++"])
+    # experiment_name == file stem, preserving the grid's 1:1 mapping of
+    # config file to experiment logs folder
+    large_batch_stem = "mini-imagenet_maml++-tpu_large_batch_256"
     fields.update(
-        # experiment_name == file stem, preserving the grid's 1:1 mapping of
-        # config file to experiment logs folder
-        experiment_name="mini-imagenet_maml++-tpu_large_batch_256",
+        experiment_name=large_batch_stem,
         train_seed=0,
         batch_size=256,
         num_classes_per_set=5,
@@ -220,8 +221,7 @@ def main(root: str = ".") -> List[str]:
     )
     written.append(
         write_experiment(
-            cfg_dir, script_dir, "mini-imagenet_maml++-tpu_large_batch_256",
-            fields,
+            cfg_dir, script_dir, large_batch_stem, fields,
         )
     )
     print(f"wrote {len(written)} configs to {cfg_dir} (+ scripts)")
