@@ -1,0 +1,102 @@
+"""CLI: config -> model -> data -> experiment (ref:
+train_maml_system.py:8-15).
+
+Usage:
+    python train_maml_system.py --name_of_args_json_file experiment_config/x.json
+    python train_maml_system.py --experiment_name foo --dataset_name omniglot_dataset ...
+
+Any MAMLConfig field can be overridden on the command line; a JSON config
+file (reference format) supplies the rest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from .config import MAMLConfig, _coerce_bool
+from .data.loader import MetaLearningDataLoader
+from .experiment.builder import ExperimentBuilder
+from .parallel.distributed import initialize_distributed
+from .utils.dataset_tools import maybe_unzip_dataset
+from .experiment.system import MAMLFewShotClassifier
+
+
+def get_args(argv=None) -> MAMLConfig:
+    parser = argparse.ArgumentParser(
+        description="TPU-native MAML++ training and inference system"
+    )
+    parser.add_argument("--name_of_args_json_file", type=str, default="None")
+    for f in dataclasses.fields(MAMLConfig):
+        if f.name == "name_of_args_json_file":
+            continue
+        parser.add_argument(f"--{f.name}", type=str, default=None)
+    ns = parser.parse_args(argv)
+    overrides = {
+        k: v for k, v in vars(ns).items()
+        if v is not None and k != "name_of_args_json_file"
+    }
+    # cast strings to the declared field types; bools accept the reference's
+    # "true"/"false" strings (parser_utils.py:63-66), lists accept JSON
+    types = {f.name: f.type for f in dataclasses.fields(MAMLConfig)}
+    for k, v in list(overrides.items()):
+        t = str(types.get(k, "str"))
+        if t == "int" or t.startswith("Optional[int"):
+            overrides[k] = int(v)
+        elif t == "float":
+            overrides[k] = float(v)
+        elif t == "bool":
+            coerced = _coerce_bool(v)
+            if not isinstance(coerced, bool):
+                parser.error(f"--{k} expects 'true' or 'false', got {v!r}")
+            overrides[k] = coerced
+        elif t.startswith("List[") or t.startswith("Tuple["):
+            overrides[k] = json.loads(v)
+    if ns.name_of_args_json_file != "None":
+        return MAMLConfig.from_json_file(ns.name_of_args_json_file, **overrides)
+    return MAMLConfig(**overrides)
+
+
+def main(argv=None):
+    cfg = get_args(argv)
+    initialize_distributed()  # no-op unless a multi-host coordinator is set
+    import jax
+
+    # dataset bootstrap: fail fast before paying model init; on pods only the
+    # primary extracts (shared DATASET_DIR). The outcome (incl. the
+    # cache-invalidation flag a re-extraction sets) is broadcast so non-primary
+    # hosts fail alongside the primary instead of hanging at a barrier, and so
+    # every host agrees on whether to rebuild the path-index cache.
+    bootstrap_err = None
+    if jax.process_index() == 0:
+        try:
+            maybe_unzip_dataset(cfg)
+        except Exception as exc:
+            bootstrap_err = exc
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        ok, reset = multihost_utils.broadcast_one_to_all(
+            np.array(
+                [bootstrap_err is None, cfg.reset_stored_filepaths], np.int32
+            )
+        )
+        cfg.reset_stored_filepaths = bool(reset)
+        if not ok:
+            raise (
+                bootstrap_err
+                if bootstrap_err is not None
+                else RuntimeError("dataset bootstrap failed on the primary host")
+            )
+    elif bootstrap_err is not None:
+        raise bootstrap_err
+    model = MAMLFewShotClassifier(cfg)
+    builder = ExperimentBuilder(cfg, model, MetaLearningDataLoader)
+    builder.run_experiment()
+
+
+if __name__ == "__main__":
+    main()
