@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import sys
 
 from .config import MAMLConfig, _coerce_bool
 from .data.loader import MetaLearningDataLoader
@@ -53,7 +52,13 @@ def get_args(argv=None) -> MAMLConfig:
                 parser.error(f"--{k} expects 'true' or 'false', got {v!r}")
             overrides[k] = coerced
         elif t.startswith("List[") or t.startswith("Tuple["):
-            overrides[k] = json.loads(v)
+            try:
+                overrides[k] = json.loads(v)
+            except json.JSONDecodeError:
+                parser.error(
+                    f"--{k} expects a JSON list (e.g. \"[0.7, 0.2, 0.1]\"), "
+                    f"got {v!r}"
+                )
     if ns.name_of_args_json_file != "None":
         return MAMLConfig.from_json_file(ns.name_of_args_json_file, **overrides)
     return MAMLConfig(**overrides)

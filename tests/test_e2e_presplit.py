@@ -76,3 +76,25 @@ def test_presplit_rgb_end_to_end(tmp_path):
     assert builder2.start_epoch == 2
     builder2.run_experiment()
     assert "train_model_3" in os.listdir(builder2.saved_models_filepath)
+
+    # evaluate_on_test_set_only: skips training entirely, goes straight to
+    # the checkpoint ensemble (ref experiment_builder.py:304 gate)
+    cfg3 = cfg2.replace(evaluate_on_test_set_only=True)
+    model3 = MAMLFewShotClassifier(cfg3, use_mesh=False)
+    builder3 = ExperimentBuilder(
+        cfg3, model3, MetaLearningDataLoader,
+        experiment_root=str(tmp_path), verbose=False,
+    )
+    ckpts_before = set(os.listdir(builder3.saved_models_filepath))
+    csv_rows_before = open(
+        os.path.join(builder3.logs_filepath, "summary_statistics.csv")
+    ).read().count("\n")
+    test_only = builder3.run_experiment()
+    # no training ran: no new checkpoints, no new epoch rows
+    # (current_iter is legitimately rewritten by the ensemble's checkpoint
+    # loads — the reference's load_model does the same)
+    assert set(os.listdir(builder3.saved_models_filepath)) == ckpts_before
+    assert open(
+        os.path.join(builder3.logs_filepath, "summary_statistics.csv")
+    ).read().count("\n") == csv_rows_before
+    assert 0.0 <= test_only["test_accuracy_mean"] <= 1.0
