@@ -37,6 +37,8 @@ def main() -> None:
             overrides[key] = int(os.environ[f"BENCH_{key.upper()}"])
     if "BENCH_COMPUTE_DTYPE" in os.environ:
         overrides["compute_dtype"] = os.environ["BENCH_COMPUTE_DTYPE"]
+    if "BENCH_REMAT_POLICY" in os.environ:
+        overrides["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
     if "BENCH_USE_REMAT" in os.environ:
         raw = os.environ["BENCH_USE_REMAT"].lower()
         if raw not in ("true", "false", "0", "1"):

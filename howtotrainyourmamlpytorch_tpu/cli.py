@@ -53,12 +53,15 @@ def get_args(argv=None) -> MAMLConfig:
             overrides[k] = coerced
         elif t.startswith("List[") or t.startswith("Tuple["):
             try:
-                overrides[k] = json.loads(v)
+                parsed = json.loads(v)
             except json.JSONDecodeError:
+                parsed = None
+            if not isinstance(parsed, list):
                 parser.error(
                     f"--{k} expects a JSON list (e.g. \"[0.7, 0.2, 0.1]\"), "
                     f"got {v!r}"
                 )
+            overrides[k] = parsed
     if ns.name_of_args_json_file != "None":
         return MAMLConfig.from_json_file(ns.name_of_args_json_file, **overrides)
     return MAMLConfig(**overrides)

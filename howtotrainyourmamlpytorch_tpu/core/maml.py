@@ -164,7 +164,15 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
         adapted, frozen = partition.split_inner(cfg, net)
         step_fn = partial(inner_step, frozen, lslr_params, x_s, y_s, x_t, y_t)
         if cfg.use_remat:
-            step_fn = jax.checkpoint(step_fn)
+            if cfg.remat_policy == "dots":
+                # keep matmul/conv outputs, recompute the cheap elementwise
+                # tail — less recompute on the MXU at some memory cost
+                step_fn = jax.checkpoint(
+                    step_fn,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                )
+            else:
+                step_fn = jax.checkpoint(step_fn)
         (theta_f, bn_f), (t_losses, t_logits) = jax.lax.scan(
             step_fn, (adapted, bn_state), jnp.arange(num_steps)
         )

@@ -1,9 +1,9 @@
 """Throughput sweep over the TPU-native perf knobs.
 
 Runs ``bench.py`` (fresh process per point, so each gets a clean XLA
-compilation environment) across {compute_dtype} x {use_remat} and prints a
-ranked table plus the best point's env settings. Use on real TPU hardware to
-pick the flagship bench configuration.
+compilation environment) across {compute_dtype} x {use_remat(/remat_policy)}
+and prints a ranked table plus the best point's copy-pasteable env settings.
+Use on real TPU hardware to pick the flagship bench configuration.
 
     python script_generation_tools/bench_sweep.py [--steps 20] [--batch 8]
 """
@@ -41,34 +41,37 @@ def main() -> None:
     ap.add_argument("--timeout", type=int, default=900, help="per-point timeout (s)")
     args = ap.parse_args()
 
+    grid = [("false", "full"), ("true", "full"), ("true", "dots")]
     points = []
     for dtype in ("float32", "bfloat16"):
-        for remat in ("true", "false"):
+        for remat, policy in grid:
             ov = {
                 "BENCH_COMPUTE_DTYPE": dtype,
                 "BENCH_USE_REMAT": remat,
+                "BENCH_REMAT_POLICY": policy,
                 "BENCH_TIMED_STEPS": args.steps,
             }
             if args.batch:
                 ov["BENCH_BATCH_SIZE"] = args.batch
-            print(f"... dtype={dtype} remat={remat}", flush=True)
+            label = f"remat={remat}" + (f"/{policy}" if remat == "true" else "")
+            print(f"... dtype={dtype} {label}", flush=True)
             res = run_point(ov, args.timeout)
-            points.append((dtype, remat, res))
+            points.append((dtype, label, res, ov))
 
-    ok = [(d, r, x) for d, r, x in points if "value" in x]
+    ok = [p for p in points if "value" in p[2]]
     ok.sort(key=lambda p: -p[2]["value"])
-    print(f"\n{'dtype':<10} {'remat':<6} {'tasks/s/chip':>13}")
-    for d, r, x in ok:
-        print(f"{d:<10} {r:<6} {x['value']:>13.3f}")
-    for d, r, x in points:
+    print(f"\n{'dtype':<10} {'remat':<16} {'tasks/s/chip':>13}")
+    for d, r, x, _ in ok:
+        print(f"{d:<10} {r:<16} {x['value']:>13.3f}")
+    for d, r, x, _ in points:
         if "error" in x:
-            print(f"{d:<10} {r:<6} ERROR: {x['error']}")
+            print(f"{d:<10} {r:<16} ERROR: {x['error']}")
     if ok:
-        d, r, x = ok[0]
-        print(
-            f"\nbest: BENCH_COMPUTE_DTYPE={d} BENCH_USE_REMAT={r} "
-            f"-> {x['value']} {x['unit']}"
+        d, r, x, ov = ok[0]
+        env_line = " ".join(
+            f"{k}={v}" for k, v in ov.items() if k != "BENCH_TIMED_STEPS"
         )
+        print(f"\nbest ({x['value']} {x['unit']}): {env_line}")
 
 
 if __name__ == "__main__":

@@ -238,11 +238,13 @@ def test_grad_clamp_applied_for_imagenet(tiny_cfg, synthetic_batch):
     assert np.isfinite(float(m["loss"]))
 
 
-def test_remat_matches_no_remat(tiny_cfg, synthetic_batch):
-    """Rematerialisation must not change the meta-gradients. Compared at the
-    gradient level: post-Adam weights would amplify float-reordering noise on
-    ~zero-gradient params (conv bias under BN) into O(lr) differences."""
-    cfg_a = tiny_cfg.replace(use_remat=True)
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_remat_matches_no_remat(tiny_cfg, synthetic_batch, policy):
+    """Rematerialisation (under either policy) must not change the
+    meta-gradients. Compared at the gradient level: post-Adam weights would
+    amplify float-reordering noise on ~zero-gradient params (conv bias under
+    BN) into O(lr) differences."""
+    cfg_a = tiny_cfg.replace(use_remat=True, remat_policy=policy)
     cfg_b = tiny_cfg.replace(use_remat=False)
     sa = maml.init_state(cfg_a)
     x_s, y_s, x_t, y_t = synthetic_batch(cfg_a)
