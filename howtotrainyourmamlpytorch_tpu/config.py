@@ -113,8 +113,9 @@ class MAMLConfig:
     compute_dtype: str = "float32"  # 'float32' | 'bfloat16' compute precision
     use_remat: bool = True  # jax.checkpoint the inner step (memory vs FLOPs)
     # remat policy when use_remat: 'full' rematerializes everything;
-    # 'dots' saves matmul/conv results (dots_with_no_batch_dims_saveable) —
-    # less recompute, more memory; tune per hardware with bench_sweep
+    # 'save_conv' saves the conv outputs (named checkpoints in
+    # ops.functional.conv2d) and recomputes only the cheap elementwise tail —
+    # less MXU recompute, more memory; tune per hardware with bench_sweep
     remat_policy: str = "full"
     num_devices: int = 0  # 0 => use all visible devices for the task mesh
     use_config_init_inner_lr: bool = False  # fix the task_learning_rate quirk
@@ -167,9 +168,9 @@ class MAMLConfig:
                 f"block_order must be 'conv_norm_relu' or 'norm_conv_relu', "
                 f"got {self.block_order!r}"
             )
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "save_conv"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got "
+                f"remat_policy must be 'full' or 'save_conv', got "
                 f"{self.remat_policy!r}"
             )
         if os.environ.get("DATASET_DIR") and not os.path.isabs(self.dataset_path):

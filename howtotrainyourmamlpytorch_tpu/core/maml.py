@@ -164,12 +164,15 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
         adapted, frozen = partition.split_inner(cfg, net)
         step_fn = partial(inner_step, frozen, lslr_params, x_s, y_s, x_t, y_t)
         if cfg.use_remat:
-            if cfg.remat_policy == "dots":
-                # keep matmul/conv outputs, recompute the cheap elementwise
-                # tail — less recompute on the MXU at some memory cost
+            if cfg.remat_policy == "save_conv":
+                # keep the conv outputs (named in ops.functional.conv2d),
+                # recompute only the cheap elementwise tail — less MXU
+                # recompute at some memory cost
                 step_fn = jax.checkpoint(
                     step_fn,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "conv_out"
+                    ),
                 )
             else:
                 step_fn = jax.checkpoint(step_fn)

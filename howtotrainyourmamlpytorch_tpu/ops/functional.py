@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 # Dimension numbers for NHWC activations with HWIO kernels.
 CONV_DIMS = ("NHWC", "HWIO", "NHWC")
@@ -45,7 +46,9 @@ def conv2d(
     )
     if b is not None:
         out = out + b.astype(out.dtype)
-    return out
+    # named for remat_policy='save_conv' (save_only_these_names); a no-op
+    # unless a checkpoint policy references the name
+    return checkpoint_name(out, "conv_out")
 
 
 def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray]) -> jnp.ndarray:

@@ -41,7 +41,7 @@ def main() -> None:
     ap.add_argument("--timeout", type=int, default=900, help="per-point timeout (s)")
     args = ap.parse_args()
 
-    grid = [("false", "full"), ("true", "full"), ("true", "dots")]
+    grid = [("false", "full"), ("true", "full"), ("true", "save_conv")]
     points = []
     for dtype in ("float32", "bfloat16"):
         for remat, policy in grid:

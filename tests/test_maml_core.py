@@ -238,7 +238,7 @@ def test_grad_clamp_applied_for_imagenet(tiny_cfg, synthetic_batch):
     assert np.isfinite(float(m["loss"]))
 
 
-@pytest.mark.parametrize("policy", ["full", "dots"])
+@pytest.mark.parametrize("policy", ["full", "save_conv"])
 def test_remat_matches_no_remat(tiny_cfg, synthetic_batch, policy):
     """Rematerialisation (under either policy) must not change the
     meta-gradients. Compared at the gradient level: post-Adam weights would
