@@ -1,0 +1,163 @@
+"""Export a checkpoint of this framework to the reference (PyTorch) format.
+
+The inverse of ``import_torch_checkpoint``: converts a ``MetaState`` orbax
+checkpoint into the ``torch.save`` payload the reference's ``load_model``
+(few_shot_learning_system.py:410-424) consumes — so experiments can migrate
+in BOTH directions (e.g. validate a TPU-trained model inside the reference's
+evaluation harness). Layouts are transposed back NHWC/HWIO -> NCHW/OIHW,
+including the row-major -> channel-major flatten permutation of the linear
+head; LSLR vectors are re-mangled to the reference's key scheme. The torch
+Adam state is synthesized empty with the correct param-group arity (the
+reference's ``load_model`` unconditionally restores it,
+few_shot_learning_system.py:422); the moments themselves restart, as they are
+not translatable between optax and torch.
+
+CLI:
+    python -m howtotrainyourmamlpytorch_tpu.tools.export_torch_checkpoint \\
+        --config experiment_config/omniglot_maml++-....json \\
+        --checkpoint_dir <exp>/saved_models --model_idx latest \\
+        --output <file for torch.save>
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict
+
+import numpy as np
+
+from ..config import MAMLConfig
+from ..core import maml
+from ..models import vgg
+
+
+def convert_to_reference_state(
+    cfg: MAMLConfig,
+    net: Dict[str, Any],
+    bn: Dict[str, Any],
+    lslr: Dict[str, Any],
+) -> Dict[str, np.ndarray]:
+    """Build the reference system state_dict (numpy) from our pytrees."""
+    out: Dict[str, np.ndarray] = {}
+    fh, fw = vgg._feature_hw(cfg)
+    train_steps = cfg.number_of_training_steps_per_iter
+
+    def _truncate_steps(v: np.ndarray) -> np.ndarray:
+        # inverse of the import-side padding: this framework sizes per-step
+        # arrays by max(train, eval) steps, the reference by train steps
+        if v.ndim == 2 and v.shape[0] > train_steps:
+            return v[:train_steps]
+        return v
+
+    for key, value in net.items():
+        v = np.asarray(value, np.float32)
+        if key.endswith(".conv.weight"):
+            # HWIO -> OIHW
+            out[f"classifier.layer_dict.{key}"] = np.transpose(v, (3, 2, 0, 1))
+        elif key.endswith(".conv.bias"):
+            out[f"classifier.layer_dict.{key}"] = v
+        elif ".norm." in key:
+            stage, leaf = key.split(".norm.")
+            if cfg.norm_layer == "layer_norm" and v.ndim == 3:
+                v = np.transpose(v, (2, 0, 1))  # (h,w,c) -> (c,h,w)
+            ref_leaf = {"gamma": "weight", "beta": "bias"}[leaf]
+            out[f"classifier.layer_dict.{stage}.norm_layer.{ref_leaf}"] = (
+                _truncate_steps(v)
+            )
+        elif key == "linear.weight":
+            feat, way = v.shape
+            if cfg.max_pooling and fh * fw > 1:
+                # (h*w*c, way) -> (way, c*h*w)
+                v = v.reshape(fh, fw, cfg.cnn_num_filters, way)
+                v = np.transpose(v, (3, 2, 0, 1)).reshape(way, feat)
+            else:
+                v = v.T
+            out["classifier.layer_dict.linear.weights"] = v
+        elif key == "linear.bias":
+            out["classifier.layer_dict.linear.bias"] = v
+
+    for key, value in bn.items():
+        stage, leaf = key.split(".norm.")
+        ref_leaf = {"mean": "running_mean", "var": "running_var"}[leaf]
+        out[f"classifier.layer_dict.{stage}.norm_layer.{ref_leaf}"] = (
+            _truncate_steps(np.asarray(value, np.float32))
+        )
+
+    if cfg.norm_layer == "batch_norm" and not cfg.per_step_bn_statistics:
+        # plain-BN: this framework tracks no running stats (they never
+        # normalize anything), but the reference's layer registers them —
+        # emit the never-used init values so strict load_state_dict passes
+        f = cfg.cnn_num_filters
+        for i in range(cfg.num_stages):
+            prefix = f"classifier.layer_dict.conv{i}.norm_layer"
+            out[f"{prefix}.running_mean"] = np.zeros((f,), np.float32)
+            # the reference inits plain-mode running_var to ZEROS too
+            # (meta_...py:188 — a quirk; the stats never normalize anything)
+            out[f"{prefix}.running_var"] = np.zeros((f,), np.float32)
+
+    for key, value in lslr.items():
+        name = key
+        if name == "linear.weight":  # reference's plural quirk
+            name = "linear.weights"
+        name = name.replace(".norm.gamma", ".norm_layer.weight")
+        name = name.replace(".norm.beta", ".norm_layer.bias")
+        ref_key = ("layer_dict." + name).replace(".", "-")
+        out[
+            f"inner_loop_optimizer.names_learning_rates_dict.{ref_key}"
+        ] = np.asarray(value, np.float32)
+
+    return out
+
+
+def _fresh_adam_state_dict(cfg: MAMLConfig, state) -> Dict[str, Any]:
+    """An empty torch Adam state_dict whose single param group matches the
+    reference system's trainable-parameter count, so the reference's
+    unconditional ``optimizer.load_state_dict(state['optimizer'])``
+    (few_shot_learning_system.py:422) succeeds. Adam moments restart — the
+    moments themselves are not translatable between optax and torch.
+    """
+    import torch
+
+    from ..core import partition
+
+    n_trainable = sum(
+        1 for k in state.net if partition.is_trainable(cfg, k)
+    )
+    if cfg.learnable_per_layer_per_step_inner_loop_learning_rate:
+        n_trainable += len(state.lslr)
+    dummies = [torch.nn.Parameter(torch.zeros(1)) for _ in range(n_trainable)]
+    opt = torch.optim.Adam(
+        dummies, lr=cfg.meta_learning_rate, amsgrad=False
+    )
+    return opt.state_dict()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", required=True, help="experiment config JSON")
+    ap.add_argument("--checkpoint_dir", required=True, help="saved_models dir")
+    ap.add_argument("--model_idx", default="latest")
+    ap.add_argument("--output", required=True, help="torch checkpoint file to write")
+    args = ap.parse_args(argv)
+
+    import torch
+
+    from ..experiment import checkpoint as ckpt
+
+    cfg = MAMLConfig.from_json_file(args.config)
+    idx = args.model_idx if args.model_idx == "latest" else int(args.model_idx)
+    state, experiment_state = ckpt.load_checkpoint(
+        args.checkpoint_dir, "train_model", idx, maml.init_state(cfg)
+    )
+    ref_sd = convert_to_reference_state(cfg, state.net, state.bn, state.lslr)
+    payload = dict(experiment_state)
+    payload["network"] = {
+        k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in ref_sd.items()
+    }
+    payload["optimizer"] = _fresh_adam_state_dict(cfg, state)
+    torch.save(payload, args.output)
+    print(f"exported {args.checkpoint_dir}/train_model_{idx} -> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

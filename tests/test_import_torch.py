@@ -122,6 +122,94 @@ def test_converted_weights_reproduce_reference_logits(kw):
         )
 
 
+@needs_torch
+def test_export_import_roundtrip_exact():
+    """export -> import must reproduce every leaf bit-exactly (the layout
+    permutations are mutual inverses)."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.tools.export_torch_checkpoint import (
+        convert_to_reference_state,
+    )
+
+    cfg = _cfg(
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+    )
+    state = maml.init_state(cfg, seed=3)
+    # make leaves distinguishable from init constants
+    net = {
+        k: np.asarray(v) + 0.01 * i
+        for i, (k, v) in enumerate(sorted(state.net.items()))
+    }
+    bn = {k: np.asarray(v) + 0.5 for k, v in state.bn.items()}
+    lslr = {
+        k: np.asarray(v) * (i + 1)
+        for i, (k, v) in enumerate(sorted(state.lslr.items()))
+    }
+    ref_sd = convert_to_reference_state(cfg, net, bn, lslr)
+    net2, bn2, lslr2 = convert_network_state(cfg, ref_sd)
+    assert set(net2) == set(net) and set(bn2) == set(bn) and set(lslr2) == set(lslr)
+    for k in net:
+        np.testing.assert_array_equal(net2[k], net[k], err_msg=k)
+    for k in bn:
+        np.testing.assert_array_equal(bn2[k], bn[k], err_msg=k)
+    for k in lslr:
+        np.testing.assert_array_equal(lslr2[k], lslr[k], err_msg=k)
+
+
+@needs_reference
+@needs_torch
+def test_exported_weights_load_into_reference_model():
+    """An exported state_dict loads into the actual reference model via
+    load_state_dict and reproduces OUR logits — the export-direction parity."""
+    import jax
+    import torch
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.tools.export_torch_checkpoint import (
+        convert_to_reference_state,
+    )
+
+    cfg = _cfg(per_step_bn_statistics=True, max_pooling=True)
+    state = maml.init_state(cfg, seed=7)
+    ref_sd = convert_to_reference_state(
+        cfg, state.net, state.bn, state.lslr
+    )
+    net = _build_reference_net(cfg)
+    classifier_sd = {
+        k[len("classifier."):]: torch.from_numpy(v)
+        for k, v in ref_sd.items()
+        if k.startswith("classifier.")
+    }
+    net.load_state_dict(classifier_sd)
+
+    rng = np.random.RandomState(5)
+    h, w, c = cfg.im_shape
+    x_nchw = rng.randn(6, c, h, w).astype(np.float32)
+    x_nhwc = np.transpose(x_nchw, (0, 2, 3, 1))
+    ours, _ = vgg.apply(cfg, state.net, state.bn, x_nhwc, 0, training=True)
+    with torch.no_grad():
+        ref_logits = net.forward(
+            torch.from_numpy(x_nchw), num_step=0, training=True
+        ).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref_logits, atol=2e-4, rtol=1e-3)
+
+    # the synthesized Adam payload must load into an optimizer with the
+    # reference system's trainable-parameter arity (classifier + LSLR)
+    from howtotrainyourmamlpytorch_tpu.tools.export_torch_checkpoint import (
+        _fresh_adam_state_dict,
+    )
+
+    trainable = [p for p in net.parameters() if p.requires_grad]
+    lslr_dummies = [
+        torch.nn.Parameter(torch.zeros(1)) for _ in state.lslr
+    ] if cfg.learnable_per_layer_per_step_inner_loop_learning_rate else []
+    ref_adam = torch.optim.Adam(trainable + lslr_dummies, lr=1e-3)
+    ref_adam.load_state_dict(_fresh_adam_state_dict(cfg, state))
+
+
 @needs_reference
 @needs_torch
 def test_full_system_checkpoint_roundtrip(tmp_path):
