@@ -1,32 +1,121 @@
-"""Benchmark: meta-tasks/sec on the flagship MAML++ config.
+"""Benchmark: meta-tasks/sec + MFU on the flagship MAML++ config.
 
 Measures the steady-state throughput of the jitted second-order MAML++
 train step (Mini-ImageNet 5-way 5-shot shapes, 48-filter 4-stage backbone,
 5 inner steps — the reference's headline config) with synthetic on-device
 data, so it isolates device compute from input-pipeline effects.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no throughput numbers (BASELINE.md), so
-``vs_baseline`` is measured against our own recorded first-round number
-when present (BENCH_BASELINE.json), else 1.0.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
+informational extras: mfu, backend, n_chips).  The reference publishes no
+throughput numbers (BASELINE.md), so ``vs_baseline`` is measured against our
+own recorded baseline when present (BENCH_BASELINE.json), else 1.0.
+
+Backend selection is defensive: the requested backend is first initialized
+in a *subprocess with a timeout*, because a stalled TPU tunnel hangs (or
+raises from) ``jax.devices()`` in-process with no way to recover — that is
+what produced round 1's rc=1/no-number artifact.  On probe failure we fall
+back to the CPU backend so the driver always records a parsable line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-from __graft_entry__ import _flagship_cfg
-from howtotrainyourmamlpytorch_tpu.core import maml, msl
-
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", 3))
 TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", 20))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+
+# Peak dense-matmul FLOPs/chip by (device_kind substring, dtype).  bf16 rates
+# are the published MXU peaks; fp32 runs at roughly a third of bf16 on these
+# parts (fp32 is emulated via multiple bf16 passes).
+_PEAK_FLOPS = [
+    ("v5 lite", {"bfloat16": 197e12, "float32": 66e12}),
+    ("v5e", {"bfloat16": 197e12, "float32": 66e12}),
+    ("v5p", {"bfloat16": 459e12, "float32": 153e12}),
+    ("v4", {"bfloat16": 275e12, "float32": 92e12}),
+    ("v6", {"bfloat16": 918e12, "float32": 306e12}),
+]
+
+
+def _probe_backend() -> None:
+    """Initialize the default JAX backend in a throwaway subprocess; on
+    timeout/error force this process onto the CPU backend before jax loads."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=PROBE_TIMEOUT,
+            capture_output=True,
+        )
+        ok = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        print(
+            "bench: default backend unavailable, falling back to CPU",
+            file=sys.stderr,
+        )
+
+
+def forward_flops_per_image(cfg) -> float:
+    """Analytic forward-pass FLOPs (2·MACs) for one image through the
+    backbone of ref meta_neural_network_architectures.py:545-689: num_stages
+    3x3 convs (stride 1 + 2x2 maxpool when max_pooling, else stride 2),
+    flatten (or global avg-pool) -> linear head."""
+    h, w = cfg.image_height, cfg.image_width
+    cin = cfg.image_channels
+    flops = 0.0
+    for _ in range(cfg.num_stages):
+        if cfg.max_pooling:
+            flops += 2.0 * h * w * 9 * cin * cfg.cnn_num_filters
+            h, w = h // 2, w // 2
+        else:
+            h, w = (h + 1) // 2, (w + 1) // 2
+            flops += 2.0 * h * w * 9 * cin * cfg.cnn_num_filters
+        cin = cfg.cnn_num_filters
+    feat = h * w * cfg.cnn_num_filters if cfg.max_pooling else cfg.cnn_num_filters
+    flops += 2.0 * feat * cfg.num_classes_per_set
+    return flops
+
+
+def train_flops_per_task(cfg, second_order: bool = True) -> float:
+    """Analytic FLOPs for one task in the second-order MAML++ train step.
+
+    Inner loop: per step, support fwd (F_s) + support grad (~2·F_s) +
+    target fwd for MSL (F_t) -> T = steps·(3·F_s + F_t) forward-equivalent
+    FLOPs.  The outer backward differentiates through the entire unrolled
+    graph (ref few_shot_learning_system.py:138 create_graph=True), costing
+    ~2·T more; first-order drops that to ~2·F_t-ish but we keep the model
+    simple and only quote MFU for the second-order flagship step.
+    """
+    f_img = forward_flops_per_image(cfg)
+    f_s = f_img * cfg.num_classes_per_set * cfg.num_samples_per_class
+    f_t = f_img * cfg.num_classes_per_set * cfg.num_target_samples
+    steps = cfg.number_of_training_steps_per_iter
+    inner = steps * (3.0 * f_s + f_t)
+    return inner * (3.0 if second_order else 1.5)
+
+
+def _peak_flops(device_kind: str, dtype: str) -> float | None:
+    kind = device_kind.lower()
+    for key, table in _PEAK_FLOPS:
+        if key in kind:
+            return table.get(dtype, table["float32"])
+    return None
 
 
 def main() -> None:
+    _probe_backend()
     import jax
 
     n_chips = max(1, len(jax.devices()))
