@@ -59,8 +59,9 @@ def _probe_backend() -> None:
     except subprocess.TimeoutExpired:
         ok = False
     if not ok:
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        from __graft_entry__ import force_cpu_backend
+
+        force_cpu_backend(clear=False)  # jax not imported yet: env is enough
         print(
             "bench: default backend unavailable, falling back to CPU",
             file=sys.stderr,
@@ -114,11 +115,37 @@ def _peak_flops(device_kind: str, dtype: str) -> float | None:
     return None
 
 
+def _devices_or_cpu():
+    """In-process ``jax.devices()`` with a last-ditch CPU retry.
+
+    The subprocess probe can pass and the in-process init still fail (flaky
+    tunnel) — that exact sequence produced round 2's rc=1.  An unguarded
+    device query must never sit on the bench hot path.
+    """
+    import jax
+
+    try:
+        return jax.devices()
+    except Exception as e:  # noqa: BLE001 - any backend failure -> CPU
+        print(f"bench: in-process backend init failed ({e!r}); "
+              "retrying on CPU", file=sys.stderr)
+        from __graft_entry__ import force_cpu_backend
+
+        force_cpu_backend()
+        return jax.devices()
+
+
 def main() -> None:
     _probe_backend()
     import jax
 
-    n_chips = max(1, len(jax.devices()))
+    devices = _devices_or_cpu()
+    backend = devices[0].platform
+    device_kind = devices[0].device_kind
+    n_chips = max(1, len(devices))
+    # deferred until the backend is settled: these imports initialize jax
+    from __graft_entry__ import _flagship_cfg
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
     overrides = {}
     for key in ("batch_size", "cnn_num_filters", "image_height", "image_width",
                 "number_of_training_steps_per_iter"):
@@ -181,22 +208,49 @@ def main() -> None:
 
     tasks_per_sec = TIMED_STEPS * b / elapsed / n_chips
 
-    baseline = 0.0
-    if os.path.exists("BENCH_BASELINE.json"):
-        with open("BENCH_BASELINE.json") as f:
-            baseline = float(json.load(f).get("value", 0.0))
-    vs_baseline = tasks_per_sec / baseline if baseline > 0 else 1.0
-
-    print(
-        json.dumps(
-            {
-                "metric": "meta_tasks_per_sec_per_chip",
-                "value": round(tasks_per_sec, 3),
-                "unit": "tasks/s/chip",
-                "vs_baseline": round(vs_baseline, 3),
-            }
-        )
+    peak = _peak_flops(device_kind, cfg.compute_dtype)
+    mfu = (
+        round(tasks_per_sec * train_flops_per_task(cfg) / peak, 4)
+        if peak
+        else None
     )
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    baseline, baseline_backend = 0.0, None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            rec = json.load(f)
+        baseline = float(rec.get("value", 0.0))
+        baseline_backend = rec.get("backend")
+    # a CPU-fallback number vs a TPU baseline (or vice versa) is not a
+    # regression signal — only compare within the same backend
+    comparable = baseline > 0 and baseline_backend == backend
+    vs_baseline = tasks_per_sec / baseline if comparable else 1.0
+
+    result = {
+        "metric": "meta_tasks_per_sec_per_chip",
+        "value": round(tasks_per_sec, 3),
+        "unit": "tasks/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "mfu": mfu,
+        "backend": backend,
+        "device_kind": device_kind,
+        "n_chips": n_chips,
+        "dtype": cfg.compute_dtype,
+        "batch_size": b,
+    }
+    if baseline_backend is not None and not comparable:
+        result["baseline_backend"] = baseline_backend
+
+    if backend == "tpu" and not os.path.exists(baseline_path) and \
+            os.environ.get("BENCH_NO_BASELINE_WRITE") != "1":
+        # first successful TPU run records itself as the comparison point
+        # for future rounds (the reference publishes no throughput numbers)
+        with open(baseline_path, "w") as f:
+            json.dump(result, f, indent=1)
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

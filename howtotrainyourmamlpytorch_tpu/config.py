@@ -26,7 +26,7 @@ import dataclasses
 import json
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 
 def _coerce_bool(value: Any) -> Any:
@@ -74,6 +74,10 @@ class MAMLConfig:
     )
     reverse_channels: bool = False
     labels_as_int: bool = False
+    # CIFAR-family normalization stats (ref data.py:86-90 reads
+    # args.classification_mean/std); scalar or per-channel list
+    classification_mean: Union[float, List[float]] = 0.5
+    classification_std: Union[float, List[float]] = 0.5
     reset_stored_filepaths: bool = False
     num_dataprovider_workers: int = 4
     samples_per_iter: int = 1

@@ -140,8 +140,8 @@ def augment_image(
         image = padded[top : top + 32, left : left + 32]
         if rng.randint(0, 2):
             image = image[:, ::-1].copy()
-    mean = np.asarray(getattr(cfg, "classification_mean", 0.5), np.float32)
-    std = np.asarray(getattr(cfg, "classification_std", 0.5), np.float32)
+    mean = np.asarray(cfg.classification_mean, np.float32)
+    std = np.asarray(cfg.classification_std, np.float32)
     return (image - mean) / std
 
 

@@ -22,14 +22,18 @@ Cache layout per (dataset, set, shape):
   ``<cache_dir>/<dataset>_<set>_<h>x<w>x<c>.u8``    raw (n, h, w, c) uint8
   ``<cache_dir>/<dataset>_<set>_<h>x<w>x<c>.json``  class order/counts + done flag
 
-The done flag is written only after the memmap is flushed, so a killed build
-is rebuilt, never served half-written. Multi-host runs should point
-``cache_dir`` at host-local storage or pre-build the cache once.
+Builds write pid-suffixed temp files and ``os.replace`` them into place, and
+the done-flagged meta lands only after the data file: a killed or truncated
+build is rebuilt, never served half-written, and concurrent builders
+(multi-process data loading, multi-host on shared storage) each land a
+complete identical file instead of interleaving writes. Corrupt/truncated
+meta JSON reads as "no cache" rather than crashing the run.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import json
 import os
 from typing import Dict, List
@@ -70,8 +74,11 @@ def build_set_cache(
 
     meta = None
     if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            meta = None  # truncated/corrupt meta == no meta: rebuild
     fresh = not (
         meta
         and meta.get("done")
@@ -83,31 +90,45 @@ def build_set_cache(
     if fresh:
         os.makedirs(cache_dir, exist_ok=True)
         # invalidate any stale meta BEFORE touching the data file: a rebuild
-        # killed mid-decode must never be servable under the old meta
-        if os.path.exists(meta_path):
+        # killed mid-decode must never be servable under the old meta.
+        # A concurrent builder may have removed it first — that's fine.
+        with contextlib.suppress(FileNotFoundError):
             os.remove(meta_path)
-        mm = np.memmap(
-            data_path, mode="w+", dtype=np.uint8, shape=(total, h, w, c)
-        )
-        jobs = []
-        offset = 0
-        for key, count in zip(order, counts):
-            for j, path in enumerate(classes[key]):
-                jobs.append((offset + j, path))
-            offset += count
-        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
-            for idx, arr in pool.map(
-                lambda job: (job[0], load_image_uint8(cfg, job[1])),
-                jobs,
-                chunksize=64,
-            ):
-                mm[idx] = arr
-        mm.flush()
-        del mm
-        with open(meta_path, "w") as f:
-            json.dump(
-                {"classes": order, "counts": counts, "done": True}, f
+        # build into pid-suffixed temps and os.replace into place: a killed
+        # build leaves only temps (never a half-written live file), and two
+        # processes racing on the same cache each land a complete, identical
+        # (deterministic decode) file instead of interleaving writes
+        data_tmp = f"{data_path}.tmp.{os.getpid()}"
+        meta_tmp = f"{meta_path}.tmp.{os.getpid()}"
+        try:
+            mm = np.memmap(
+                data_tmp, mode="w+", dtype=np.uint8, shape=(total, h, w, c)
             )
+            jobs = []
+            offset = 0
+            for key, count in zip(order, counts):
+                for j, path in enumerate(classes[key]):
+                    jobs.append((offset + j, path))
+                offset += count
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                for idx, arr in pool.map(
+                    lambda job: (job[0], load_image_uint8(cfg, job[1])),
+                    jobs,
+                    chunksize=64,
+                ):
+                    mm[idx] = arr
+            mm.flush()
+            del mm
+            os.replace(data_tmp, data_path)
+            with open(meta_tmp, "w") as f:
+                json.dump(
+                    {"classes": order, "counts": counts, "done": True}, f
+                )
+            os.replace(meta_tmp, meta_path)
+        finally:
+            for tmp in (data_tmp, meta_tmp):
+                with contextlib.suppress(FileNotFoundError):
+                    os.remove(tmp)
 
     mm = np.memmap(data_path, mode="r", dtype=np.uint8, shape=(total, h, w, c))
     views: Dict[str, np.ndarray] = {}

@@ -101,6 +101,7 @@ class ExperimentBuilder:
         # reference only records epoch_run_time)
         self.step_timer = StepTimer()
         self._active_pbar = None
+        self._pbar_sums: Dict[str, tuple] = {}
         self._tracing = False
         self._steps_this_run = 0
         # multi-host: checkpoint saves are collective (orbax), but metric
@@ -142,6 +143,21 @@ class ExperimentBuilder:
             return None
 
         return tqdm(total=total, desc=desc, leave=False)
+
+    @staticmethod
+    def _running_summary(sums, total_losses, phase) -> Dict[str, float]:
+        """Incremental per-epoch running mean for the interactive postfix.
+
+        ``build_summary_dict`` re-reduces the full metric history on every
+        call, which made the per-tick postfix O(n²) over an epoch; this
+        consumes only the entries appended since the previous tick."""
+        for key, vals in total_losses.items():
+            s, n = sums.get(key, (0.0, 0))
+            for v in vals[n:]:
+                s += float(np.asarray(v))
+                n += 1
+            sums[key] = (s, n)
+        return {f"{phase}_{k}_mean": s / n for k, (s, n) in sums.items() if n}
 
     @staticmethod
     def _pbar_tick(pbar, summary: Dict[str, float], phase: str):
@@ -200,6 +216,7 @@ class ExperimentBuilder:
 
     def run_validation_epoch(self) -> Dict[str, float]:
         total_losses: Dict[str, List[float]] = {}
+        pbar_sums: Dict[str, tuple] = {}
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
         pbar = self._pbar(n_batches, "val")
         try:
@@ -207,7 +224,9 @@ class ExperimentBuilder:
                 self.evaluation_iteration(val_sample, total_losses)
                 if pbar is not None:  # interactive: pay the sync for liveness
                     self._pbar_tick(
-                        pbar, self.build_summary_dict(total_losses, "val"), "val"
+                        pbar,
+                        self._running_summary(pbar_sums, total_losses, "val"),
+                        "val",
                     )
         finally:
             if pbar is not None:
@@ -287,7 +306,9 @@ class ExperimentBuilder:
                     # batch runs stay fully pipelined (no per-step sync)
                     self._pbar_tick(
                         self._active_pbar,
-                        self.build_summary_dict(self.total_losses, "train"),
+                        self._running_summary(
+                            self._pbar_sums, self.total_losses, "train"
+                        ),
                         "train",
                     )
 
@@ -320,6 +341,7 @@ class ExperimentBuilder:
                     )
                     self.pack_and_save_metrics(train_losses, val_losses)
                     self.total_losses = {}
+                    self._pbar_sums = {}
                     self.epochs_done_in_this_run += 1
                     if self.is_primary:
                         save_to_json(

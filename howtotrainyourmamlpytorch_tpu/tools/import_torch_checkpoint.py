@@ -140,9 +140,18 @@ def import_torch_checkpoint(cfg: MAMLConfig, torch_ckpt_path: str):
     Adam moments) plus the carried-over experiment-state scalars."""
     import torch
 
-    payload = torch.load(
-        torch_ckpt_path, map_location="cpu", weights_only=False
-    )
+    try:
+        # safe path first: tensors-only unpickling, no arbitrary-code objects
+        payload = torch.load(
+            torch_ckpt_path, map_location="cpu", weights_only=True
+        )
+    except Exception:
+        # reference checkpoints store the experiment-state scalars alongside
+        # the tensors (experiment_builder.py:190-206) and may need the full
+        # unpickler; only fall back for files the user chose to import
+        payload = torch.load(
+            torch_ckpt_path, map_location="cpu", weights_only=False
+        )
     network = payload["network"] if "network" in payload else payload
     state_dict = {k: v.detach().cpu().numpy() for k, v in network.items()}
     params, bn_state, lslr = convert_network_state(cfg, state_dict)

@@ -1,0 +1,109 @@
+"""Driver-artifact smoke tests.
+
+``bench.py`` and ``__graft_entry__.entry()`` are the two things the round
+driver executes; round 2 shipped a bench that died with NameError on every
+backend because nothing in the suite ran them.  These tests close that hole:
+the bench must always print one parsable JSON line (on any backend), and
+``entry()`` must return a jittable (fn, args) pair.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_prints_parsable_json_line():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_WARMUP_STEPS="1",
+        BENCH_TIMED_STEPS="2",
+        BENCH_BATCH_SIZE="2",
+        BENCH_CNN_NUM_FILTERS="8",
+        BENCH_IMAGE_HEIGHT="16",
+        BENCH_IMAGE_WIDTH="16",
+        BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER="2",
+        BENCH_NO_BASELINE_WRITE="1",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, f"bench.py failed:\n{out.stderr[-3000:]}"
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "meta_tasks_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["unit"] == "tasks/s/chip"
+    assert rec["vs_baseline"] > 0
+    assert rec["backend"] == "cpu"
+    assert rec["n_chips"] >= 1
+    assert rec["dtype"] in ("float32", "bfloat16")
+    # CPU has no published MXU peak -> mfu is null, never a bogus number
+    assert rec["mfu"] is None
+
+
+def test_bench_flops_model_is_sane():
+    """The analytic FLOPs model should agree with a hand count on a small
+    known config (one conv stage + head, max-pooling path)."""
+    import bench as bench_mod
+
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _flagship_cfg
+
+    cfg = _flagship_cfg(
+        image_height=8,
+        image_width=8,
+        image_channels=3,
+        num_stages=1,
+        cnn_num_filters=4,
+        num_classes_per_set=5,
+    )
+    # conv: 2 * H*W * k*k * cin * cout = 2*8*8*9*3*4; head on 4*4*4 feat
+    expected = 2.0 * 8 * 8 * 9 * 3 * 4 + 2.0 * (4 * 4 * 4) * 5
+    got = bench_mod.forward_flops_per_image(cfg)
+    assert got == expected
+    # train FLOPs must scale linearly in inner steps
+    one = bench_mod.train_flops_per_task(
+        _flagship_cfg(number_of_training_steps_per_iter=1)
+    )
+    five = bench_mod.train_flops_per_task(
+        _flagship_cfg(number_of_training_steps_per_iter=5)
+    )
+    assert abs(five / one - 5.0) < 1e-9
+
+
+def test_peak_flops_lookup():
+    import bench as bench_mod
+
+    assert bench_mod._peak_flops("TPU v5e", "bfloat16") == 197e12
+    assert bench_mod._peak_flops("TPU v4", "float32") == 92e12
+    assert bench_mod._peak_flops("cpu", "float32") is None
+
+
+def test_graft_entry_fn_jits_and_runs():
+    """entry() must return (fn, args) that jit-compiles and produces
+    logits of shape (n*s, n) — the driver compile-checks exactly this."""
+    import jax
+
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _flagship_cfg, entry
+
+    fn, args = entry()
+    out = jax.jit(fn)(*args)
+    cfg = _flagship_cfg()
+    n, s = cfg.num_classes_per_set, cfg.num_samples_per_class
+    assert out.shape == (n * s, n)
+    assert np.all(np.isfinite(np.asarray(out)))

@@ -82,3 +82,32 @@ def test_loads_actual_reference_config():
     assert cfg.sets_are_pre_split is True
     assert cfg.max_pooling is True
     assert cfg.total_epochs == 100
+
+
+def test_classification_mean_std_from_json(tmp_path):
+    """CIFAR normalization stats are real config fields consumed by the
+    augment pipeline (ref data.py:86-90), not silently-dropped JSON keys."""
+    import numpy as np
+
+    from howtotrainyourmamlpytorch_tpu.data.episodes import augment_image
+
+    path = tmp_path / "c.json"
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "dataset_name": "cifar_fs",
+                "classification_mean": [0.5071, 0.4866, 0.4409],
+                "classification_std": [0.2673, 0.2564, 0.2762],
+            },
+            f,
+        )
+    cfg = MAMLConfig.from_json_file(str(path))
+    assert cfg.classification_mean == [0.5071, 0.4866, 0.4409]
+    assert cfg.classification_std == [0.2673, 0.2564, 0.2762]
+    img = np.full((32, 32, 3), 0.5071, np.float32)
+    out = augment_image(cfg, img, k=0, augment=False)
+    # channel 0 was exactly at its mean -> normalizes to 0
+    np.testing.assert_allclose(out[..., 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(
+        out[..., 1], (0.5071 - 0.4866) / 0.2564, rtol=1e-5
+    )

@@ -103,6 +103,43 @@ def test_cache_files_reused_and_rebuilt_on_mismatch(tmp_path):
     assert rebuilt["counts"] == good_counts and rebuilt["done"]
 
 
+def test_truncated_meta_rebuilds_instead_of_crashing(tmp_path):
+    """A meta file truncated mid-write (killed build) must read as 'no
+    cache' and trigger a rebuild — not crash the run with JSONDecodeError."""
+    root = tmp_path / "data"
+    _write_dataset(str(root), n_classes=10, per_class=4, size=8, mode="1")
+    cfg = _cfg(
+        root, tmp_path / "cache", dataset_name="omniglot_dataset",
+        image_height=8, image_width=8, image_channels=1, use_mmap_cache=True,
+    )
+    b1 = _first_batches(cfg, n=1)
+    base = preprocess._cache_base(cfg, cfg.cache_dir, "train")
+    with open(base + ".json") as f:
+        good = f.read()
+    with open(base + ".json", "w") as f:
+        f.write(good[: len(good) // 2])  # truncated: invalid JSON
+    b2 = _first_batches(cfg, n=1)  # must not raise
+    np.testing.assert_array_equal(b1[0][0], b2[0][0])
+    with open(base + ".json") as f:
+        assert json.load(f)["done"]
+
+
+def test_build_leaves_no_temp_files(tmp_path):
+    """Builds go through pid-suffixed temps + os.replace; after a build the
+    cache dir contains only the final .u8/.json pairs."""
+    root = tmp_path / "data"
+    _write_dataset(str(root), n_classes=10, per_class=4, size=8, mode="1")
+    cfg = _cfg(
+        root, tmp_path / "cache", dataset_name="omniglot_dataset",
+        image_height=8, image_width=8, image_channels=1, use_mmap_cache=True,
+    )
+    _first_batches(cfg, n=1)
+    leftovers = [
+        f for f in os.listdir(cfg.cache_dir) if ".tmp." in f
+    ]
+    assert leftovers == []
+
+
 def test_half_written_cache_not_served(tmp_path):
     """A build killed before the done flag is rebuilt from scratch."""
     root = tmp_path / "data"
