@@ -1,0 +1,58 @@
+"""Build the bootstrap-compatible Omniglot archive.
+
+Packs an ``omniglot_dataset/`` folder (1623 character classes x 20 drawings,
+``alphabet/character/*.png``) into ``omniglot_dataset.tar.bz2`` with the
+top-level folder name the extraction bootstrap expects
+(``utils/dataset_tools.py``: archive at ``$DATASET_DIR/<dataset_name>.tar.bz2``
+must contain ``<dataset_name>/``).
+
+    python datasets/make_omniglot_archive.py --source /root/reference/datasets/omniglot_dataset
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tarfile
+
+EXPECTED_FILES = 1623 * 20
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--source", required=True,
+        help="existing omniglot_dataset folder (e.g. an upstream checkout's "
+        "datasets/omniglot_dataset)",
+    )
+    ap.add_argument(
+        "--out", default=os.path.join(os.path.dirname(__file__) or ".",
+                                      "omniglot_dataset.tar.bz2"),
+    )
+    args = ap.parse_args()
+
+    n = sum(
+        1
+        for _, _, files in os.walk(args.source)
+        for f in files
+        if f.lower().endswith(".png")
+    )
+    if n != EXPECTED_FILES:
+        print(
+            f"warning: {args.source} has {n} PNGs, expected {EXPECTED_FILES} "
+            "(the bootstrap's count validation will re-extract and then fail)",
+            file=sys.stderr,
+        )
+
+    tmp = args.out + ".tmp"
+    with tarfile.open(tmp, "w:bz2") as tf:
+        # arcname pins the top-level folder name the bootstrap requires
+        tf.add(args.source, arcname="omniglot_dataset")
+    os.replace(tmp, args.out)
+    print(f"wrote {args.out} ({os.path.getsize(args.out) / 1e6:.1f} MB, {n} images)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,15 +58,30 @@ def save_checkpoint(
     few_shot_learning_system.py:399-408)."""
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     tmp = path + ".tmp"
-    shutil.rmtree(tmp, ignore_errors=True)
+    multiprocess = jax.process_count() > 1
+    if not multiprocess or jax.process_index() == 0:
+        shutil.rmtree(tmp, ignore_errors=True)
     ckptr = ocp.StandardCheckpointer()
+    # collective in multi-process runs: every process calls save on the SAME
+    # path (orbax shards the write and barriers internally)
     ckptr.save(os.path.join(tmp, "state"), state._asdict())
     ckptr.wait_until_finished()
-    with open(os.path.join(tmp, _EXPERIMENT_STATE_FILE), "w") as f:
-        json.dump(experiment_state, f, cls=_NumpyEncoder)
-    # atomic-ish swap, like the reference's overwrite of train_model_latest
-    shutil.rmtree(path, ignore_errors=True)
-    os.replace(tmp, path)
+    if not multiprocess or jax.process_index() == 0:
+        # host-side files + the atomic-ish swap happen once per (shared)
+        # filesystem, not once per process — concurrent rmtree/os.replace of
+        # the same path from two processes would race
+        with open(os.path.join(tmp, _EXPERIMENT_STATE_FILE), "w") as f:
+            json.dump(experiment_state, f, cls=_NumpyEncoder)
+        shutil.rmtree(path, ignore_errors=True)
+        os.replace(tmp, path)
+    if multiprocess:
+        from jax.experimental import multihost_utils
+
+        # non-primary processes must not race ahead and load (or re-save)
+        # before the primary's swap lands
+        multihost_utils.sync_global_devices(
+            f"ckpt_swap_{model_name}_{model_idx}"
+        )
     return path
 
 

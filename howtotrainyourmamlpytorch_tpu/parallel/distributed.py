@@ -89,10 +89,17 @@ def hybrid_task_mesh(
     if processes is None and jax.process_count() > 1:
         from jax.experimental import mesh_utils
 
+        # granule = PROCESS, not slice: the loader assigns global-batch slice
+        # [p*per_host, (p+1)*per_host) to process p, so mesh row p must hold
+        # exactly process p's devices for make_array_from_process_local_data
+        # to place each host's data on its own chips. (Slice granules would
+        # also reject single-slice multi-host pods and multi-process CPU,
+        # where n_granules != n_proc.)
         grid = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(1, per_host),
             dcn_mesh_shape=(n_proc, 1),
             devices=devs,
+            process_is_granule=True,
         )
     else:
         # single process (incl. simulated hosts): group by (process, id) so

@@ -1,0 +1,81 @@
+"""Subprocess worker for the true multi-process distributed test.
+
+Launched by ``tests/test_parallel.py::test_two_process_training_matches_single``
+as 2 coordinated processes (CPU backend, 4 virtual devices each) and once as
+a single 8-device process. Runs a few training epochs through ``cli.main`` —
+the same entry the reference's launch scripts hit — so the real
+``jax.distributed.initialize``, ``create_hybrid_device_mesh``,
+``make_array_from_process_local_data``, bootstrap broadcast, collective
+checkpointing, and primary-only metric writes all execute across genuine
+process boundaries (supersedes ref few_shot_learning_system.py:73-81, whose
+only scaling mechanism is single-process nn.DataParallel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--num_processes", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--n_local_devices", type=int, required=True)
+    ap.add_argument("--data_root", required=True)
+    ap.add_argument("--exp_name", required=True)
+    ap.add_argument("--cache_dir", required=True)
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.n_local_devices}"
+    )
+    if args.num_processes > 1:
+        # cli.main -> initialize_distributed() reads exactly these env vars
+        os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{args.port}"
+        os.environ["JAX_NUM_PROCESSES"] = str(args.num_processes)
+        os.environ["JAX_PROCESS_ID"] = str(args.process_id)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from howtotrainyourmamlpytorch_tpu.cli import main as cli_main
+
+    argv = [
+        "--experiment_name", args.exp_name,
+        # an "imagenet"-family name (RGB /255 + stat normalize + grad clamp)
+        # that is NOT a known vendored dataset, so the bootstrap's file-count
+        # contract treats it as a user dataset
+        "--dataset_name", "imagenet_synthetic_presplit",
+        "--dataset_path", args.data_root,
+        "--sets_are_pre_split", "true",
+        "--indexes_of_folders_indicating_class", "[-3, -2]",
+        "--image_height", "10", "--image_width", "10", "--image_channels", "3",
+        "--num_classes_per_set", "2", "--num_samples_per_class", "1",
+        "--num_target_samples", "1",
+        "--batch_size", "8",  # global meta-batch: 1 task per device
+        "--cnn_num_filters", "4", "--num_stages", "2", "--max_pooling", "true",
+        "--per_step_bn_statistics", "true",
+        "--learnable_per_layer_per_step_inner_loop_learning_rate", "true",
+        "--use_multi_step_loss_optimization", "true",
+        "--second_order", "true",
+        "--number_of_training_steps_per_iter", "2",
+        "--number_of_evaluation_steps_per_iter", "2",
+        "--total_epochs", "2", "--total_iter_per_epoch", "2",
+        "--num_evaluation_tasks", "8",
+        "--num_dataprovider_workers", "2",
+        "--cache_dir", args.cache_dir,
+        "--use_mmap_cache", "true",
+        "--use_remat", "false",
+        "--seed", "0",
+    ]
+    cli_main(argv)
+    print(f"WORKER_DONE process={jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,8 @@ equivalent to single-device execution — the TPU-native replacement for
 ``nn.DataParallel``'s scatter/gather must be a pure re-layout (SURVEY.md
 §2.2). The reference could never test this (no distributed backend)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -132,3 +134,142 @@ def test_submesh_sizes(tiny_cfg, synthetic_batch):
         xs, ys, xt, yt = mesh_lib.shard_batch(mesh, x_s, y_s, x_t, y_t)
         _, m = step(sr, xs, ys, xt, yt, _weights(cfg), 0.01)
         assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), rel=1e-5)
+
+
+# -- true multi-process execution (VERDICT r2 #3) -------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch_training(exp_name, data_root, cache_dir,
+                     num_processes, n_local_devices, timeout=900):
+    """Launch `num_processes` coordinated _mp_train_worker.py subprocesses
+    and return their outputs (raises on any non-zero exit)."""
+    import subprocess
+    import sys as _sys
+
+    port = _free_port()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(os.path.dirname(__file__), "_mp_train_worker.py")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # workers own their XLA_FLAGS/JAX_PLATFORMS; drop the conftest's
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                _sys.executable, worker,
+                "--process_id", str(pid),
+                "--num_processes", str(num_processes),
+                "--port", str(port),
+                "--n_local_devices", str(n_local_devices),
+                "--data_root", str(data_root),
+                "--exp_name", str(exp_name),
+                "--cache_dir", str(cache_dir),
+            ],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(num_processes)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"worker {pid} failed rc={p.returncode}:\n{out[-4000:]}"
+        )
+    return outs
+
+
+def _read_csv_columns(path):
+    import csv
+
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert rows, f"no rows in {path}"
+    out = {}
+    for k in rows[0]:
+        try:
+            out[k] = np.array([float(r[k]) for r in rows])
+        except (TypeError, ValueError):
+            pass  # non-numeric column
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_training_matches_single(tmp_path):
+    """Two REAL processes (jax.distributed.initialize, CPU backend, 4 virtual
+    devices each) train through cli.main and must produce the same per-epoch
+    losses as one 8-device process on the same global task stream.
+
+    This executes — across genuine process boundaries — the hybrid DCN x ICI
+    mesh (`create_hybrid_device_mesh`), per-host batch slices assembled with
+    `make_array_from_process_local_data`, the dataset-bootstrap broadcast,
+    collective orbax checkpointing with a primary-only swap, primary-only
+    metric writes, and the cross-host prediction allgather of the test
+    ensemble. The reference has no distributed backend at all
+    (few_shot_learning_system.py:73-81 is single-process nn.DataParallel).
+    """
+    from test_e2e_presplit import _write_presplit_rgb
+
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root), n_classes=4, per_class=6, size=10)
+
+    exp_multi = tmp_path / "exp_multi"
+    exp_single = tmp_path / "exp_single"
+    cache_dir = tmp_path / "cache"
+
+    outs = _launch_training(
+        exp_multi, data_root, cache_dir, num_processes=2, n_local_devices=4,
+    )
+    assert any("WORKER_DONE process=0" in o for o in outs)
+    assert any("WORKER_DONE process=1" in o for o in outs)
+
+    _launch_training(
+        exp_single, data_root, cache_dir, num_processes=1, n_local_devices=8,
+    )
+
+    csv_multi = _read_csv_columns(
+        os.path.join(exp_multi, "logs", "summary_statistics.csv")
+    )
+    csv_single = _read_csv_columns(
+        os.path.join(exp_single, "logs", "summary_statistics.csv")
+    )
+    assert len(csv_multi["train_loss_mean"]) == 2  # both trained 2 epochs
+    for key in ("train_loss_mean", "val_loss_mean"):
+        np.testing.assert_allclose(
+            csv_multi[key], csv_single[key], atol=2e-3,
+            err_msg=f"{key} diverged between 2-process and single-process",
+        )
+    for key in ("train_accuracy_mean", "val_accuracy_mean"):
+        # identical stream; allow one task flip from fp reduction order
+        np.testing.assert_allclose(
+            csv_multi[key], csv_single[key], atol=0.13, err_msg=key,
+        )
+    # only the primary process wrote metric files in the 2-process run:
+    # exactly one header + one data row, not two processes' interleaved writes
+    with open(os.path.join(exp_multi, "logs", "test_summary.csv")) as f:
+        test_rows = [ln for ln in f.read().splitlines() if ln.strip()]
+    assert len(test_rows) == 2, test_rows
+    assert test_rows[0].startswith("test_accuracy")
+    # both runs produced the dual checkpoints
+    for exp in (exp_multi, exp_single):
+        saved = os.listdir(os.path.join(exp, "saved_models"))
+        assert "train_model_latest" in saved and "train_model_2" in saved
