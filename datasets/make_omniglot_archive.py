@@ -16,7 +16,12 @@ import os
 import sys
 import tarfile
 
-EXPECTED_FILES = 1623 * 20
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import (  # noqa: E402
+    EXPECTED_COUNTS,
+)
+
+EXPECTED_FILES = EXPECTED_COUNTS["omniglot_dataset"]
 
 
 def main() -> int:

@@ -61,6 +61,15 @@ def save_checkpoint(
     multiprocess = jax.process_count() > 1
     if not multiprocess or jax.process_index() == 0:
         shutil.rmtree(tmp, ignore_errors=True)
+    if multiprocess:
+        from jax.experimental import multihost_utils
+
+        # a killed run can leave a stale tmp on the shared filesystem; no
+        # process may reach orbax's destination-exists check before the
+        # primary's cleanup lands
+        multihost_utils.sync_global_devices(
+            f"ckpt_tmp_clean_{model_name}_{model_idx}"
+        )
     ckptr = ocp.StandardCheckpointer()
     # collective in multi-process runs: every process calls save on the SAME
     # path (orbax shards the write and barriers internally)

@@ -53,6 +53,8 @@ def test_bench_prints_parsable_json_line():
     assert rec["dtype"] in ("float32", "bfloat16")
     # CPU has no published MXU peak -> mfu is null, never a bogus number
     assert rec["mfu"] is None
+    # non-TPU backends run the reduced workload and say so
+    assert rec["reduced"] is True
 
 
 def test_bench_flops_model_is_sane():

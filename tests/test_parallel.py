@@ -182,15 +182,18 @@ def _launch_training(exp_name, data_root, cache_dir,
         )
         for pid in range(num_processes)
     ]
-    outs = []
-    for p in procs:
+    # drain all pipes concurrently: a worker blocked on a full stdout pipe
+    # inside a collective would deadlock the whole gang
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(len(procs)) as pool:
+        futs = [pool.submit(p.communicate, timeout=timeout) for p in procs]
         try:
-            out, _ = p.communicate(timeout=timeout)
+            outs = [f.result()[0] for f in futs]
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
-        outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {pid} failed rc={p.returncode}:\n{out[-4000:]}"
