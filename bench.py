@@ -272,7 +272,11 @@ def main() -> None:
         mesh = mesh_lib.task_mesh(n_chips)
         state = mesh_lib.replicate_state(mesh, state)
         x_s, y_s, x_t, y_t = mesh_lib.shard_batch(mesh, x_s, y_s, x_t, y_t)
-    step = jax.jit(maml.make_train_step(cfg, second_order=True))
+    # donate the state like the real system does (experiment/system.py) —
+    # without it the TPU keeps two copies of params+Adam state alive
+    step = jax.jit(
+        maml.make_train_step(cfg, second_order=True), donate_argnums=(0,)
+    )
 
     for _ in range(warmup_steps):
         state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
