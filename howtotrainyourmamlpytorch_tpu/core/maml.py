@@ -199,17 +199,33 @@ def _merge_bn(bn_batched: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     return jax.tree_util.tree_map(lambda v: jnp.mean(v, axis=0), bn_batched)
 
 
-def _meta_loss_and_grads(learner, state, x_s, y_s, x_t, y_t, loss_weights):
-    """Outer loss + meta-gradients over the vmapped task batch."""
+def _map_tasks(learner_call, mode, x_s, y_s, x_t, y_t):
+    """Run the per-task learner over the task axis.
+
+    'vmap' (default): one batched program — per-task adapted weights make
+    the convs *grouped* convs, which the MXU eats but XLA:CPU's conv path
+    handles an order of magnitude below peak. 'map' (lax.map = scan):
+    sequential per-task execution with ordinary convs — the right choice on
+    CPU hosts (measured 5-10x faster at 64 filters), numerically equivalent.
+    """
+    if mode == "map":
+        return jax.lax.map(lambda a: learner_call(*a), (x_s, y_s, x_t, y_t))
+    return jax.vmap(learner_call)(x_s, y_s, x_t, y_t)
+
+
+def _meta_loss_and_grads(
+    learner, state, x_s, y_s, x_t, y_t, loss_weights, task_mode="vmap"
+):
+    """Outer loss + meta-gradients over the task batch."""
 
     def outer_loss(trainable):
-        per_task = jax.vmap(
+        losses, (correct, bns, _) = _map_tasks(
             lambda xs, ys, xt, yt: learner(
                 trainable["net"], trainable["lslr"], state.bn,
                 xs, ys, xt, yt, loss_weights,
-            )
+            ),
+            task_mode, x_s, y_s, x_t, y_t,
         )
-        losses, (correct, bns, _) = per_task(x_s, y_s, x_t, y_t)
         # mean over tasks (few_shot_learning_system.py:164)
         return jnp.mean(losses), (correct, bns)
 
@@ -235,7 +251,8 @@ def make_grads_fn(cfg: MAMLConfig, second_order: bool):
 
     def grads_fn(state: MetaState, x_s, y_s, x_t, y_t, loss_weights):
         _, loss, _, _, grads = _meta_loss_and_grads(
-            learner, state, x_s, y_s, x_t, y_t, loss_weights
+            learner, state, x_s, y_s, x_t, y_t, loss_weights,
+            cfg.task_axis_mode,
         )
         return loss, grads
 
@@ -255,7 +272,8 @@ def make_train_step(cfg: MAMLConfig, second_order: bool):
         # inside the traced function is free
         opt = make_optimizer(cfg, state.net)
         trainable, loss, correct, bns, grads = _meta_loss_and_grads(
-            learner, state, x_s, y_s, x_t, y_t, loss_weights
+            learner, state, x_s, y_s, x_t, y_t, loss_weights,
+            cfg.task_axis_mode,
         )
         if cfg.clip_grads:
             # elementwise clamp to ±10, net params only
@@ -298,12 +316,12 @@ def make_eval_step(cfg: MAMLConfig):
     loss_weights = jnp.asarray(msl_lib.final_step_only(num_steps))
 
     def eval_step(state: MetaState, x_s, y_s, x_t, y_t):
-        per_task = jax.vmap(
+        losses, (correct, _, preds) = _map_tasks(
             lambda xs, ys, xt, yt: learner(
                 state.net, state.lslr, state.bn, xs, ys, xt, yt, loss_weights
-            )
+            ),
+            cfg.task_axis_mode, x_s, y_s, x_t, y_t,
         )
-        losses, (correct, _, preds) = per_task(x_s, y_s, x_t, y_t)
         metrics = {"loss": jnp.mean(losses), "accuracy": jnp.mean(correct)}
         return metrics, preds
 

@@ -83,6 +83,15 @@ class MAMLFewShotClassifier:
             if n > 1:
                 self.mesh = mesh_lib.task_mesh(n)
                 self.state = mesh_lib.replicate_state(self.mesh, self.state)
+        if self.mesh is not None and cfg.task_axis_mode == "map":
+            # numerically fine but lax.map serializes the sharded task axis,
+            # collapsing N-device throughput to ~1 device
+            print(
+                "[system] WARNING: task_axis_mode='map' on a multi-device "
+                "mesh runs tasks sequentially; use 'vmap' (the default) on "
+                "TPU meshes — 'map' is the single-core CPU fast path",
+                flush=True,
+            )
         self._train_steps: Dict[bool, Any] = {}
         self._eval_step = jax.jit(maml.make_eval_step(cfg))
         # 1-step-lag sync handle: bounds device run-ahead to one in-flight

@@ -1,0 +1,221 @@
+"""Same-host throughput head-to-head: this framework vs the PyTorch reference.
+
+Runs the identical second-order MAML++ outer step (same task shapes, same
+mechanism set: LSLR + MSL + per-step BN) through BOTH implementations on the
+same machine and prints one JSON line with meta-tasks/sec for each and the
+ratio. The reference publishes no throughput numbers (BASELINE.md), so this
+is the only direct perf comparison available without TPU hardware — run it
+on a quiet machine.
+
+The reference implementation is loaded from ``$REFERENCE_DIR`` (default
+``/root/reference``) via its own ``get_args`` (patched argv + a temp JSON in
+its config format, exactly how its launcher builds the args object); nothing
+from the reference is copied here.
+
+    JAX_PLATFORMS=cpu python script_generation_tools/bench_vs_reference.py \
+        [--filters 16] [--steps 3] [--batch 4] [--way 5] [--shot 1] \
+        [--timed 10] [--skip-reference]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REFERENCE_DIR = os.environ.get("REFERENCE_DIR", "/root/reference")
+
+
+def _task_batch(b, n, s, t, h, w, c, seed=0):
+    rng = np.random.RandomState(seed)
+    x_s = rng.randn(b, n, s, h, w, c).astype(np.float32)
+    x_t = rng.randn(b, n, t, h, w, c).astype(np.float32)
+    y_s = np.tile(np.arange(n, dtype=np.int64)[None, :, None], (b, 1, s))
+    y_t = np.tile(np.arange(n, dtype=np.int64)[None, :, None], (b, 1, t))
+    return x_s, x_t, y_s, y_t
+
+
+def time_ours(a) -> float:
+    """Steady-state meta-tasks/sec of our jitted second-order train step."""
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
+    import jax
+
+    cfg = MAMLConfig(
+        dataset_name="omniglot_dataset",
+        image_height=28, image_width=28, image_channels=1,
+        num_classes_per_set=a.way, num_samples_per_class=a.shot,
+        num_target_samples=1, batch_size=a.batch,
+        cnn_num_filters=a.filters, num_stages=4, max_pooling=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True, second_order=True,
+        number_of_training_steps_per_iter=a.steps,
+        number_of_evaluation_steps_per_iter=a.steps,
+        use_remat=a.remat,
+        task_axis_mode=a.task_mode,
+    )
+    state = maml.init_state(cfg)
+    x_s, x_t, y_s, y_t = _task_batch(
+        a.batch, a.way, a.shot, 1, 28, 28, 1
+    )
+    y_s, y_t = y_s.astype(np.int32), y_t.astype(np.int32)
+    weights = np.asarray(
+        msl.loss_weights_for(a.steps, True, True, 0,
+                             cfg.multi_step_loss_num_epochs)
+    )
+    step = jax.jit(
+        maml.make_train_step(cfg, second_order=True), donate_argnums=(0,)
+    )
+    for _ in range(2):  # compile + settle
+        state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
+    jax.block_until_ready(state.net)
+    t0 = time.perf_counter()
+    for _ in range(a.timed):
+        state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
+    jax.block_until_ready(state.net)
+    return a.timed * a.batch / (time.perf_counter() - t0)
+
+
+def time_reference(a) -> float:
+    """Steady-state meta-tasks/sec of the reference's run_train_iter on the
+    same config (ref few_shot_learning_system.py:338-369)."""
+    sys.path.insert(0, REFERENCE_DIR)
+    # same-host CPU comparison: hide any GPU (async CUDA timing would need
+    # explicit synchronization and would not be same-device anyway) and make
+    # the reference's $DATASET_DIR path join work without a real dataset
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "")
+    os.environ.setdefault("DATASET_DIR", tempfile.gettempdir())
+    import torch
+
+    torch.set_num_threads(1)
+
+    cfg = {
+        "batch_size": a.batch,
+        "image_height": 28, "image_width": 28, "image_channels": 1,
+        "gpu_to_use": 0, "num_dataprovider_workers": 1,
+        "max_models_to_save": 5,
+        "dataset_name": "omniglot_dataset", "dataset_path": "omniglot_dataset",
+        "reset_stored_paths": False, "experiment_name": "bench_ref",
+        "train_seed": 0, "val_seed": 0,
+        "train_val_test_split": [0.71, 0.03, 0.26],
+        "indexes_of_folders_indicating_class": [-3, -2],
+        "sets_are_pre_split": False, "load_into_memory": False,
+        "init_inner_loop_learning_rate": 0.1,
+        "multi_step_loss_num_epochs": 15,
+        "minimum_per_task_contribution": 0.01,
+        "num_evaluation_tasks": 40,
+        "learnable_per_layer_per_step_inner_loop_learning_rate": True,
+        "enable_inner_loop_optimizable_bn_params": False,
+        "total_epochs": 100, "total_iter_per_epoch": 100,
+        "continue_from_epoch": -2,
+        "evaluate_on_test_set_only": False,
+        "max_pooling": True, "per_step_bn_statistics": True,
+        "learnable_batch_norm_momentum": False,
+        "evalute_on_test_set_only": False,
+        "learnable_bn_gamma": True, "learnable_bn_beta": True,
+        "weight_decay": 0.0, "dropout_rate_value": 0.0,
+        "min_learning_rate": 1e-5, "meta_learning_rate": 1e-3,
+        "total_epochs_before_pause": 100,
+        "first_order_to_second_order_epoch": -1,
+        "norm_layer": "batch_norm",
+        "cnn_num_filters": a.filters, "num_stages": 4, "conv_padding": True,
+        "number_of_training_steps_per_iter": a.steps,
+        "number_of_evaluation_steps_per_iter": a.steps,
+        "cnn_blocks_per_stage": 1,
+        "num_classes_per_set": a.way, "num_samples_per_class": a.shot,
+        "num_target_samples": 1,
+        "second_order": True, "use_multi_step_loss_optimization": True,
+    }
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        json.dump(cfg, f)
+        cfg_path = f.name
+    argv_backup = sys.argv
+    sys.argv = ["bench_vs_reference", "--name_of_args_json_file", cfg_path]
+    try:
+        from utils.parser_utils import get_args
+
+        args, device = get_args()
+    finally:
+        sys.argv = argv_backup
+        os.unlink(cfg_path)
+    device = torch.device("cpu")
+    from few_shot_learning_system import MAMLFewShotClassifier
+
+    model = MAMLFewShotClassifier(
+        args=args, device=device,
+        im_shape=(2, args.image_channels, args.image_height,
+                  args.image_width),
+    )
+    x_s, x_t, y_s, y_t = _task_batch(
+        a.batch, a.way, a.shot, 1, 28, 28, 1
+    )
+    # reference layout is channels-first: (b, n, s, c, h, w)
+    x_s = np.moveaxis(x_s, -1, 3)
+    x_t = np.moveaxis(x_t, -1, 3)
+    batch = (x_s, x_t, y_s, y_t)
+    for _ in range(2):  # settle (no compile, but first-iter allocs)
+        model.run_train_iter(data_batch=batch, epoch=0)
+    t0 = time.perf_counter()
+    for _ in range(a.timed):
+        model.run_train_iter(data_batch=batch, epoch=0)
+    return a.timed * a.batch / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--filters", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--way", type=int, default=5)
+    ap.add_argument("--shot", type=int, default=1)
+    ap.add_argument("--timed", type=int, default=10)
+    ap.add_argument(
+        "--remat", action=argparse.BooleanOptionalAction, default=False,
+        help="jax.checkpoint the inner step (a TPU memory/FLOPs trade; "
+        "wasteful on CPU, so off by default here)",
+    )
+    ap.add_argument(
+        "--task-mode", default="map", choices=("vmap", "map"),
+        help="'map' (sequential tasks, ordinary convs) is the CPU-host fast "
+        "path; 'vmap' is the TPU default (grouped convs for the MXU)",
+    )
+    ap.add_argument("--skip-reference", action="store_true")
+    a = ap.parse_args()
+
+    ours = time_ours(a)
+    ref = None
+    if not a.skip_reference:
+        if not os.path.isdir(REFERENCE_DIR):
+            print(f"reference not found at {REFERENCE_DIR}", file=sys.stderr)
+        else:
+            ref = time_reference(a)
+    print(
+        json.dumps(
+            {
+                "config": f"omniglot {a.way}way-{a.shot}shot "
+                          f"{a.filters}f/{a.steps}steps/b{a.batch}",
+                "remat": a.remat,
+                "task_mode": a.task_mode,
+                "ours_tasks_per_sec": round(ours, 3),
+                "reference_tasks_per_sec": round(ref, 3) if ref else None,
+                "speedup_vs_reference": round(ours / ref, 2) if ref else None,
+                "host": "cpu (same machine; torch pinned to 1 thread, "
+                        "CUDA hidden)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
