@@ -192,16 +192,37 @@ def _devices_watchdogged():
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            capture_output=True,
-            text=True,
-        )
-        sys.stderr.write(r.stderr)
-        if r.stdout:
-            print(r.stdout.strip().splitlines()[-1])
-        os._exit(r.returncode)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                # the fallback run must itself be bounded or a wedged env
+                # defeats the always-emit-a-line goal; 3x the init budget
+                # plus slack covers the reduced-workload run comfortably
+                timeout=3 * INIT_TIMEOUT + 600,
+            )
+            stderr, stdout, rc = r.stderr, r.stdout, r.returncode
+        except subprocess.TimeoutExpired as e:
+            def _txt(v):
+                return v.decode() if isinstance(v, bytes) else (v or "")
+            stderr = _txt(e.stderr)
+            # keep any partial output: the child may have printed its result
+            # line and then wedged in teardown — exactly the mode this
+            # watchdog exists for
+            stdout = _txt(e.stdout)
+            rc = 1
+            stderr += "\nbench: CPU re-exec timed out as well; giving up\n"
+        sys.stderr.write(stderr)
+        lines = stdout.strip().splitlines()
+        if lines:
+            print(lines[-1], flush=True)
+        # os._exit skips stdio flushing — with block-buffered pipes the one
+        # parsable line would be lost; flush both streams explicitly first
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
     if isinstance(result[0], BaseException):
         raise result[0]
     return result[0]

@@ -219,6 +219,19 @@ class MAMLConfig:
         return "imagenet" in self.dataset_name
 
     @property
+    def global_tasks_per_batch(self) -> int:
+        """Tasks the loader stacks per global batch
+        (``num_of_gpus * batch_size * samples_per_iter``, ref data.py:580) —
+        the single definition used by the loader AND by mesh sizing, so the
+        task axis the mesh shards always matches what the loader produces.
+        """
+        return (
+            max(1, self.num_of_gpus)
+            * self.batch_size
+            * max(1, self.samples_per_iter)
+        )
+
+    @property
     def bn_num_steps(self) -> int:
         """Size of the per-step BN arrays.
 

@@ -96,8 +96,15 @@ def decode_cached(cfg: MAMLConfig, arr: np.ndarray) -> np.ndarray:
     else (:389-391).
     """
     if "omniglot" in cfg.dataset_name:
-        return arr.astype(np.float32)
-    return arr.astype(np.float32) / 255.0
+        out = arr.astype(np.float32)
+    else:
+        out = arr.astype(np.float32) / 255.0
+    if cfg.reverse_channels:
+        # RGB->BGR flip on the decoded-but-unnormalized values, the
+        # reference's preprocess_data (data.py:442-457) which runs after
+        # load_batch's decode/scale and before get_set's normalization
+        out = np.ascontiguousarray(out[..., ::-1])
+    return out
 
 
 def augment_stack(

@@ -104,8 +104,7 @@ class MetaLearningDataLoader:
                  shard_id: Optional[int] = None,
                  num_shards: Optional[int] = None):
         self.cfg = cfg
-        ndev = max(1, cfg.num_of_gpus)
-        self.tasks_per_batch = ndev * cfg.batch_size * cfg.samples_per_iter
+        self.tasks_per_batch = cfg.global_tasks_per_batch
         if num_shards is None:
             if shard_id is not None:
                 raise ValueError("shard_id given without num_shards")

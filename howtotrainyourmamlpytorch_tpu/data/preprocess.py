@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import contextlib
+import glob
 import json
 import os
 from typing import Dict, List
@@ -98,6 +99,21 @@ def build_set_cache(
         # build leaves only temps (never a half-written live file), and two
         # processes racing on the same cache each land a complete, identical
         # (deterministic decode) file instead of interleaving writes
+        # disk hygiene: a SIGKILLed builder leaves its pid-suffixed temps
+        # behind forever (finally never ran); sweep stale ones for this cache
+        # base before building. A concurrent builder's temp is LIVE, not
+        # stale — deleting it would unlink the file under its memmap and
+        # crash its os.replace — so only remove temps whose pid is dead.
+        for path_base in (data_path, meta_path):
+            for stale in glob.glob(f"{path_base}.tmp.*"):
+                try:
+                    pid = int(stale.rsplit(".", 1)[-1])
+                    os.kill(pid, 0)  # raises if no such process
+                except ValueError:
+                    continue  # unrecognized suffix: leave it alone
+                except OSError:
+                    with contextlib.suppress(OSError):
+                        os.remove(stale)
         data_tmp = f"{data_path}.tmp.{os.getpid()}"
         meta_tmp = f"{meta_path}.tmp.{os.getpid()}"
         try:

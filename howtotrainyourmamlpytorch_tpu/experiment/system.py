@@ -63,9 +63,7 @@ class MAMLFewShotClassifier:
             # over the global mesh
             from ..parallel import distributed
 
-            total_tasks = (
-                max(1, cfg.num_of_gpus) * cfg.batch_size * cfg.samples_per_iter
-            )
+            total_tasks = cfg.global_tasks_per_batch
             n_dev = len(jax.devices())
             if total_tasks % n_dev != 0:
                 raise ValueError(
@@ -76,8 +74,11 @@ class MAMLFewShotClassifier:
             self.state = mesh_lib.replicate_state(self.mesh, self.state)
         elif use_mesh and len(jax.devices()) > 1:
             n = cfg.num_devices if cfg.num_devices > 0 else len(jax.devices())
-            # the mesh size must divide the meta-batch
-            total_tasks = cfg.batch_size * max(1, cfg.samples_per_iter)
+            # the mesh size must divide the meta-batch. Sized from the SAME
+            # task count the loader stacks (cfg.global_tasks_per_batch) —
+            # sizing from batch_size alone would quietly under-shard a
+            # num_of_gpus>1 config.
+            total_tasks = cfg.global_tasks_per_batch
             while n > 1 and total_tasks % n != 0:
                 n -= 1
             if n > 1:

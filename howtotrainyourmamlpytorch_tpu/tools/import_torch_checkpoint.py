@@ -32,6 +32,8 @@ CLI:
 from __future__ import annotations
 
 import argparse
+import pickle
+import sys
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -145,10 +147,18 @@ def import_torch_checkpoint(cfg: MAMLConfig, torch_ckpt_path: str):
         payload = torch.load(
             torch_ckpt_path, map_location="cpu", weights_only=True
         )
-    except Exception:
+    except (pickle.UnpicklingError, RuntimeError, TypeError):
+        # TypeError: torch < 1.13 has no weights_only kwarg at all
         # reference checkpoints store the experiment-state scalars alongside
         # the tensors (experiment_builder.py:190-206) and may need the full
-        # unpickler; only fall back for files the user chose to import
+        # unpickler; only fall back for files the user chose to import —
+        # and say so, since the full unpickler executes code in the file
+        print(
+            f"import_torch_checkpoint: weights_only load failed for "
+            f"{torch_ckpt_path!r}; falling back to the UNSAFE full "
+            f"unpickler (only do this for files you trust)",
+            file=sys.stderr,
+        )
         payload = torch.load(
             torch_ckpt_path, map_location="cpu", weights_only=False
         )

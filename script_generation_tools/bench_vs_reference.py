@@ -12,6 +12,12 @@ The reference implementation is loaded from ``$REFERENCE_DIR`` (default
 its config format, exactly how its launcher builds the args object); nothing
 from the reference is copied here.
 
+SECURITY NOTE: the reference half imports and executes the reference
+checkout's code *in this process* with full user privileges. The reference
+tree is third-party content — only run this explicit opt-in benchmark
+against a checkout you trust, or pass ``--skip-reference`` to measure just
+our half.
+
     JAX_PLATFORMS=cpu python script_generation_tools/bench_vs_reference.py \
         [--filters 16] [--steps 3] [--batch 4] [--way 5] [--shot 1] \
         [--timed 10] [--skip-reference]

@@ -222,3 +222,50 @@ def test_loader_rejects_indivisible_shards(tmp_path):
         MetaLearningDataLoader(
             cfg, cache_dir=str(tmp_path), shard_id=0, num_shards=2
         )
+
+
+def test_reverse_channels_flips_rgb_order():
+    """reverse_channels flips RGB->BGR on decoded-but-unnormalized values
+    (ref data.py:442-457, preprocess_data after load_batch's decode/scale)."""
+    from howtotrainyourmamlpytorch_tpu.data.episodes import decode_cached
+
+    cfg = _cfg(
+        dataset_name="mini_imagenet", image_channels=3, reverse_channels=True
+    )
+    arr = np.arange(2 * 2 * 3, dtype=np.uint8).reshape(2, 2, 3)
+    out = decode_cached(cfg, arr)
+    expected = (arr.astype(np.float32) / 255.0)[..., ::-1]
+    np.testing.assert_allclose(out, expected)
+    # flag off: untouched
+    cfg_off = _cfg(dataset_name="mini_imagenet", image_channels=3)
+    np.testing.assert_allclose(
+        decode_cached(cfg_off, arr), arr.astype(np.float32) / 255.0
+    )
+
+
+def test_reverse_channels_in_episode_before_normalization():
+    """The mmap-cache fast path (uint8 stores) reverses channels BEFORE the
+    ImageNet-stat normalization, matching the reference's order (load_batch
+    -> preprocess_data -> get_set's normalize): normalize(reverse(x)), not
+    reverse(normalize(x))."""
+    from howtotrainyourmamlpytorch_tpu.data.episodes import (
+        IMAGENET_MEAN,
+        IMAGENET_STD,
+    )
+
+    cfg = _cfg(
+        dataset_name="mini_imagenet", image_channels=3, reverse_channels=True
+    )
+    rng = np.random.RandomState(0)
+    classes = {
+        str(i): rng.randint(0, 255, (7, 8, 8, 3), dtype=np.uint8)
+        for i in range(6)
+    }
+    keys = np.array(list(classes.keys()))
+    ep = sample_episode(cfg, classes, keys, seed=11, augment=False)
+    cfg_off = _cfg(dataset_name="mini_imagenet", image_channels=3)
+    ep_off = sample_episode(cfg_off, classes, keys, seed=11, augment=False)
+    # undo the off-run's normalization, reverse, re-normalize == on-run
+    raw = ep_off.x_support * IMAGENET_STD + IMAGENET_MEAN
+    expected = (raw[..., ::-1] - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(ep.x_support, expected, rtol=1e-5, atol=1e-6)

@@ -87,3 +87,33 @@ def test_validation_iter_returns_preds_only_on_request(tiny_cfg):
     b = tiny_cfg.batch_size
     n, t = tiny_cfg.num_classes_per_set, tiny_cfg.num_target_samples
     assert preds.shape == (b, n * t, n)
+
+
+def test_mesh_sized_from_loader_task_count(tiny_cfg):
+    """Mesh sizing must use the SAME task count the loader stacks
+    (num_of_gpus * batch_size * samples_per_iter, data/loader.py): a
+    num_of_gpus=2 config on 8 virtual devices gets the full 8-way mesh
+    (8 | 2*4*1), and a train iter over the loader-convention batch runs."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_cfg, num_of_gpus=2, batch_size=4)
+    model = MAMLFewShotClassifier(cfg, use_mesh=True)
+    assert model.mesh is not None
+    assert model.mesh.devices.size == 8
+    # the loader stacks num_of_gpus * batch_size tasks per global batch
+    from conftest import make_synthetic_batch
+
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, batch_size=8)
+    losses = model.run_train_iter((x_s, x_t, y_s, y_t), epoch=0)
+    assert np.isfinite(float(losses["loss"]))
+
+
+def test_mesh_undersized_without_num_of_gpus_factor(tiny_cfg):
+    """Regression guard for the round-3 finding: batch_size=6 alone does not
+    divide 8 devices (falls to 6), but with num_of_gpus=4 the loader stacks
+    24 tasks and the mesh must be the full 8."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_cfg, num_of_gpus=4, batch_size=6)
+    model = MAMLFewShotClassifier(cfg, use_mesh=True)
+    assert model.mesh is not None and model.mesh.devices.size == 8
