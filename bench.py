@@ -303,11 +303,18 @@ def main() -> None:
         state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
     jax.block_until_ready(state.net)
 
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    if trace_dir:
+        # capture an XLA/TensorBoard profile of the timed region — the
+        # artifact the TPU-day analysis starts from
+        jax.profiler.start_trace(trace_dir)
     start = time.perf_counter()
     for _ in range(timed_steps):
         state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
     jax.block_until_ready(state.net)
     elapsed = time.perf_counter() - start
+    if trace_dir:
+        jax.profiler.stop_trace()
 
     tasks_per_sec = timed_steps * b / elapsed / n_chips
 

@@ -1,0 +1,94 @@
+#!/bin/bash
+# TPU tunnel watcher: poll until the axon tunnel is UP, then seize it.
+#
+# On the first successful probe this runs, in order, logging everything under
+# $ARTIFACT_DIR (default /root/repo/.round4):
+#   1. bench.py at the full flagship config  -> BENCH_TPU.json line
+#      (bench.py itself records BENCH_BASELINE.json on a TPU backend)
+#   2. bench_sweep.py dtype x remat grid     -> SWEEP_TPU.txt
+#   3. bench.py with BENCH_TRACE_DIR set     -> profiler trace artifact
+#   4. full-width Omniglot 20-way 1-shot MAML++ training (64 filters,
+#      5 inner steps — experiment_config/omniglot_maml++-omniglot_1_20_8_0.1_64_0.json)
+#      in the background, kill-safe checkpoints under /tmp/omniglot_20way_64f
+#
+# A CPU training run can register its pid in $CPU_TRAIN_PIDFILE; it is
+# SIGSTOPped while TPU work runs (1-core host: the trainer would starve the
+# TPU host loop) and SIGCONTed if the seizure fails so nothing is lost.
+#
+# Usage: nohup bash script_generation_tools/tpu_watch.sh >/dev/null 2>&1 &
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+ARTIFACT_DIR="${ARTIFACT_DIR:-$REPO/.round4}"
+CPU_TRAIN_PIDFILE="${CPU_TRAIN_PIDFILE:-/tmp/round4_cpu_train.pid}"
+PROBE_INTERVAL="${PROBE_INTERVAL:-600}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-240}"
+LOG="$ARTIFACT_DIR/tpu_watch.log"
+mkdir -p "$ARTIFACT_DIR"
+
+say() { echo "$(date +%F\ %T) $*" >> "$LOG"; }
+
+cpu_trainer_signal() {  # STOP or CONT the registered CPU trainer, if any
+    local sig="$1"
+    if [[ -f "$CPU_TRAIN_PIDFILE" ]]; then
+        local pid
+        pid=$(cat "$CPU_TRAIN_PIDFILE")
+        if kill -0 "$pid" 2>/dev/null; then
+            kill "-$sig" "$pid" 2>/dev/null && say "sent SIG$sig to CPU trainer $pid"
+        fi
+    fi
+}
+
+probe() {  # 0 iff the default backend is a real TPU
+    local out
+    out=$(timeout "$PROBE_TIMEOUT" python -c \
+        "import jax; d=jax.devices(); print(d[0].platform, d[0].device_kind, len(d))" \
+        2>/dev/null | tail -1)
+    say "probe: ${out:-DOWN(rc=$?)}"
+    [[ "$out" == tpu* ]]
+}
+
+seize() {
+    say "TPU UP — seizing"
+    cpu_trainer_signal STOP
+
+    say "[1/4] bench.py flagship"
+    if ! timeout 5400 python "$REPO/bench.py" \
+            > "$ARTIFACT_DIR/BENCH_TPU.json" 2> "$ARTIFACT_DIR/BENCH_TPU.err"; then
+        say "bench.py FAILED (see BENCH_TPU.err) — releasing"
+        cpu_trainer_signal CONT
+        return 1
+    fi
+    say "bench.py: $(tail -1 "$ARTIFACT_DIR/BENCH_TPU.json")"
+
+    say "[2/4] bench_sweep.py"
+    timeout 10800 python "$REPO/script_generation_tools/bench_sweep.py" \
+        --steps 20 > "$ARTIFACT_DIR/SWEEP_TPU.txt" 2>&1 \
+        || say "bench_sweep FAILED (non-fatal, see SWEEP_TPU.txt)"
+
+    say "[3/4] profiler trace"
+    BENCH_TRACE_DIR="$ARTIFACT_DIR/trace" BENCH_TIMED_STEPS=5 \
+        timeout 3600 python "$REPO/bench.py" \
+        > "$ARTIFACT_DIR/BENCH_TRACE.json" 2>> "$ARTIFACT_DIR/BENCH_TPU.err" \
+        || say "trace capture FAILED (non-fatal)"
+
+    say "[4/4] launching full-width Omniglot 20-way training"
+    DATASET_DIR=/root/reference nohup python "$REPO/train_maml_system.py" \
+        --name_of_args_json_file "$REPO/experiment_config/omniglot_maml++-omniglot_1_20_8_0.1_64_0.json" \
+        --experiment_name /tmp/omniglot_20way_64f \
+        --use_mmap_cache true --load_into_memory false \
+        >> "$ARTIFACT_DIR/train_64f_tpu.log" 2>&1 &
+    say "training pid $! (log: train_64f_tpu.log)"
+    return 0
+}
+
+say "watcher started (interval ${PROBE_INTERVAL}s, timeout ${PROBE_TIMEOUT}s)"
+while true; do
+    if probe; then
+        if seize; then
+            say "seizure complete — watcher exiting (training continues in background)"
+            exit 0
+        fi
+    fi
+    sleep "$PROBE_INTERVAL"
+done
