@@ -298,6 +298,17 @@ def main() -> None:
     step = jax.jit(
         maml.make_train_step(cfg, second_order=True), donate_argnums=(0,)
     )
+    # AOT-compile first so we can read XLA's own FLOPs count for this exact
+    # executable (validates the analytic model; see test_flops_model.py).
+    # The jit call below hits the same executable cache — no double compile.
+    xla_flops_per_batch = None
+    try:
+        compiled = step.lower(
+            state, x_s, y_s, x_t, y_t, weights, 1e-3
+        ).compile()
+        xla_flops_per_batch = float(compiled.cost_analysis()["flops"])
+    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
+        print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
 
     for _ in range(warmup_steps):
         state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
@@ -319,9 +330,24 @@ def main() -> None:
     tasks_per_sec = timed_steps * b / elapsed / n_chips
 
     peak = _peak_flops(device_kind, cfg.compute_dtype)
+    # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
+    # recompute) over peak. hfu: *executed* FLOPs per XLA's cost analysis of
+    # this exact executable (includes remat recompute) over peak. The two
+    # counts cross-validate: test_flops_model.py pins them within 20% at
+    # conv-dominated widths with remat off.
     mfu = (
         round(tasks_per_sec * train_flops_per_task(cfg) / peak, 4)
         if peak
+        else None
+    )
+    # cost_analysis() is PER-DEVICE on a sharded executable: it counts the
+    # partitioned module, i.e. b / n_chips tasks' worth of work
+    xla_flops_per_task = (
+        xla_flops_per_batch / (b / n_chips) if xla_flops_per_batch else None
+    )
+    hfu = (
+        round(tasks_per_sec * xla_flops_per_task / peak, 4)
+        if peak and xla_flops_per_task
         else None
     )
 
@@ -344,6 +370,10 @@ def main() -> None:
         "unit": "tasks/s/chip",
         "vs_baseline": round(vs_baseline, 3),
         "mfu": mfu,
+        "hfu": hfu,
+        "xla_flops_per_task": (
+            round(xla_flops_per_task) if xla_flops_per_task else None
+        ),
         "backend": backend,
         "device_kind": device_kind,
         "n_chips": n_chips,

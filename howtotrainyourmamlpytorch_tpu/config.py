@@ -126,6 +126,11 @@ class MAMLConfig:
     # friendly, the TPU default); 'map' runs tasks sequentially with ordinary
     # convs — 5-10x faster on CPU hosts where XLA's grouped-conv path is slow
     task_axis_mode: str = "vmap"
+    # conv lowering: 'lax' = native conv (XLA tiles it onto the MXU — the
+    # TPU path); 'im2col' = patches + dot_general, whose every AD order is a
+    # GEMM — sidesteps XLA:CPU's ~40x-slow kernel-gradient conv (see
+    # ops.functional.conv2d); 'auto' = im2col on CPU backends, lax elsewhere
+    conv_impl: str = "auto"
     use_config_init_inner_lr: bool = False  # fix the task_learning_rate quirk
     cache_dir: str = ""  # where dataset path-index JSON caches go ('' => experiment dir)
     use_mmap_cache: bool = False  # preprocessed uint8 memmap image cache (data/preprocess.py)
@@ -181,6 +186,11 @@ class MAMLConfig:
                 f"task_axis_mode must be 'vmap' or 'map', got "
                 f"{self.task_axis_mode!r}"
             )
+        if self.conv_impl not in ("auto", "lax", "im2col"):
+            raise ValueError(
+                f"conv_impl must be 'auto', 'lax' or 'im2col', got "
+                f"{self.conv_impl!r}"
+            )
         if self.remat_policy not in ("full", "save_conv"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'save_conv', got "
@@ -217,6 +227,16 @@ class MAMLConfig:
         """Reference clamps outer grads to ±10 for imagenet datasets
         (few_shot_learning_system.py:332-335)."""
         return "imagenet" in self.dataset_name
+
+    @property
+    def resolved_conv_impl(self) -> str:
+        """'auto' resolved against the live backend: im2col's every-AD-order-
+        is-a-GEMM lowering wins on CPU; the native conv wins on the MXU."""
+        if self.conv_impl != "auto":
+            return self.conv_impl
+        import jax
+
+        return "im2col" if jax.default_backend() == "cpu" else "lax"
 
     @property
     def global_tasks_per_batch(self) -> int:

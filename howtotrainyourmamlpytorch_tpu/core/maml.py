@@ -176,8 +176,16 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
                 )
             else:
                 step_fn = jax.checkpoint(step_fn)
+        # fully unroll the (short: 3-5) inner loop: the step indices become
+        # literals, so per-step BN gathers/updates lower to static slices
+        # XLA can fuse instead of dynamic-update-slice machinery — a large
+        # constant-factor win on CPU, neutral-to-positive on TPU (compile
+        # time stays bounded because num_steps is small)
         (theta_f, bn_f), (t_losses, t_logits) = jax.lax.scan(
-            step_fn, (adapted, bn_state), jnp.arange(num_steps)
+            step_fn,
+            (adapted, bn_state),
+            jnp.arange(num_steps),
+            unroll=True if num_steps <= 8 else 1,
         )
         loss = jnp.dot(loss_weights.astype(t_losses.dtype), t_losses)
         final_logits = t_logits[-1]

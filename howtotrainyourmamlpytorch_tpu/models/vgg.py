@@ -199,6 +199,7 @@ def apply(
             params[f"conv{i}.conv.bias"],
             stride=stride,
             padding=pad,
+            impl=cfg.resolved_conv_impl,
         )
         if conv_first:
             out = apply_norm(out, i)

@@ -26,24 +26,70 @@ from jax.ad_checkpoint import checkpoint_name
 CONV_DIMS = ("NHWC", "HWIO", "NHWC")
 
 
+def _im2col(
+    x: jnp.ndarray, kh: int, kw: int, stride: int, padding: int
+) -> jnp.ndarray:
+    """Extract conv patches: (N,H,W,C) -> (N,Ho,Wo,kh*kw*C).
+
+    Built from pad + strided-slice + concat only, so every AD order stays in
+    cheap data-movement ops and the conv math itself is a single dot_general.
+    """
+    if padding:
+        x = jnp.pad(x, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    n, hp, wp, c = x.shape
+    ho = (hp - kh) // stride + 1
+    wo = (wp - kw) // stride + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(
+                lax.slice(
+                    x,
+                    (0, i, j, 0),
+                    (n, i + (ho - 1) * stride + 1, j + (wo - 1) * stride + 1, c),
+                    (1, stride, stride, 1),
+                )
+            )
+    return jnp.concatenate(cols, axis=-1)
+
+
 def conv2d(
     x: jnp.ndarray,
     w: jnp.ndarray,
     b: Optional[jnp.ndarray],
     stride: int,
     padding: int,
+    impl: str = "lax",
 ) -> jnp.ndarray:
     """2-D convolution, NHWC x HWIO -> NHWC (ref: F.conv2d, meta_...py:89-97).
 
     ``padding`` is symmetric integer padding like torch's ``padding=`` int.
+
+    ``impl`` selects the lowering:
+
+    * ``"lax"`` — ``lax.conv_general_dilated``, the native conv XLA tiles
+      onto the TPU MXU; the right choice on accelerator backends.
+    * ``"im2col"`` — patches + ``dot_general``. Mathematically identical
+      (same contraction, different op), and the backward of a dot_general is
+      two more dot_generals, so EVERY derivative order lowers to GEMMs.
+      This sidesteps XLA:CPU's pathological kernel-gradient convolution
+      (profiled at ~40x a same-FLOPs GEMM: the f32[3,3,64,64] wgrad conv
+      with a 14x14 window costs ~89ms where the equivalent GEMM costs ~2ms)
+      — the dominant cost of CPU MAML training. Pure lax ops, so it remains
+      valid (just not preferred) on TPU.
     """
-    out = lax.conv_general_dilated(
-        x,
-        w.astype(x.dtype),
-        window_strides=(stride, stride),
-        padding=[(padding, padding), (padding, padding)],
-        dimension_numbers=CONV_DIMS,
-    )
+    if impl == "im2col":
+        kh, kw, cin, cout = w.shape
+        patches = _im2col(x, kh, kw, stride, padding)
+        out = patches @ w.astype(x.dtype).reshape(kh * kw * cin, cout)
+    else:
+        out = lax.conv_general_dilated(
+            x,
+            w.astype(x.dtype),
+            window_strides=(stride, stride),
+            padding=[(padding, padding), (padding, padding)],
+            dimension_numbers=CONV_DIMS,
+        )
     if b is not None:
         out = out + b.astype(out.dtype)
     # named for remat_policy='save_conv' (save_only_these_names); a no-op
@@ -60,7 +106,20 @@ def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray]) -> jnp.ndar
 
 
 def max_pool2d(x: jnp.ndarray, window: int = 2, stride: int = 2) -> jnp.ndarray:
-    """2x2 max pool, NHWC (ref: F.max_pool2d, meta_...py:605,652)."""
+    """2x2 max pool, NHWC (ref: F.max_pool2d, meta_...py:605,652).
+
+    For the window == stride case (the only one the backbone uses) the pool
+    is a reshape + max over the tile axes — identical values to the
+    reduce_window formulation (VALID: trailing odd rows/cols dropped), but
+    its gradient is an elementwise mask instead of XLA's select-and-scatter,
+    which profiles ~10x slower on CPU and is no better on TPU.
+    """
+    if window == stride:
+        n, h, w, c = x.shape
+        ho, wo = h // window, w // window
+        x = x[:, : ho * window, : wo * window, :]
+        x = x.reshape(n, ho, window, wo, window, c)
+        return jnp.max(jnp.max(x, axis=4), axis=2)
     return lax.reduce_window(
         x,
         -jnp.inf,

@@ -40,11 +40,12 @@ cpu_trainer_signal() {  # STOP or CONT the registered CPU trainer, if any
 }
 
 probe() {  # 0 iff the default backend is a real TPU
-    local out
+    local out rc
     out=$(timeout "$PROBE_TIMEOUT" python -c \
         "import jax; d=jax.devices(); print(d[0].platform, d[0].device_kind, len(d))" \
         2>/dev/null | tail -1)
-    say "probe: ${out:-DOWN(rc=$?)}"
+    rc=${PIPESTATUS[0]}  # timeout/python status, not tail's
+    say "probe: ${out:-DOWN(rc=$rc; 124=timeout)}"
     [[ "$out" == tpu* ]]
 }
 
@@ -78,7 +79,16 @@ seize() {
         --experiment_name /tmp/omniglot_20way_64f \
         --use_mmap_cache true --load_into_memory false \
         >> "$ARTIFACT_DIR/train_64f_tpu.log" 2>&1 &
-    say "training pid $! (log: train_64f_tpu.log)"
+    local train_pid=$!
+    say "training pid $train_pid (log: train_64f_tpu.log)"
+    # health-check: a startup crash must not leave the CPU trainer STOPped
+    # with nothing running
+    sleep 120
+    if ! kill -0 "$train_pid" 2>/dev/null; then
+        say "TPU training died at startup (see train_64f_tpu.log) — releasing"
+        cpu_trainer_signal CONT
+        return 1
+    fi
     return 0
 }
 

@@ -1,0 +1,104 @@
+"""Equivalence of the conv lowerings and the pool formulations.
+
+The im2col path (ops.functional.conv2d impl='im2col') exists because
+XLA:CPU's kernel-gradient convolution profiles ~40x slower than the
+same-FLOPs GEMM (see conv2d docstring); it must be numerically
+interchangeable with the native conv at every AD order the framework uses
+(forward, first-order inner grads, second-order meta-grads).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.ops import functional as F
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape).astype(np.float32))
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 1), (2, 1), (1, 0), (2, 0)])
+def test_im2col_matches_lax_forward(stride, padding):
+    x = _rand((3, 13, 13, 5), 0)
+    w = _rand((3, 3, 5, 7), 1)
+    b = _rand((7,), 2)
+    out_lax = F.conv2d(x, w, b, stride, padding, impl="lax")
+    out_im = F.conv2d(x, w, b, stride, padding, impl="im2col")
+    assert out_lax.shape == out_im.shape
+    np.testing.assert_allclose(out_lax, out_im, rtol=1e-5, atol=1e-5)
+
+
+def test_im2col_matches_lax_first_and_second_order():
+    x = _rand((2, 8, 8, 4), 3)
+    w = _rand((3, 3, 4, 6), 4)
+
+    def loss(impl):
+        def f(w):
+            return jnp.sum(F.conv2d(x, w, None, 1, 1, impl=impl) ** 2)
+
+        return f
+
+    g_lax = jax.grad(loss("lax"))(w)
+    g_im = jax.grad(loss("im2col"))(w)
+    np.testing.assert_allclose(g_lax, g_im, rtol=1e-4, atol=1e-4)
+
+    # second order: grad of a scalar function of the grad (the structure the
+    # second-order MAML outer step differentiates)
+    def meta(impl):
+        def f(w):
+            g = jax.grad(lambda w_: jnp.sum(F.conv2d(x, w_, None, 1, 1, impl=impl) ** 2))(w)
+            return jnp.sum(jnp.tanh(g))
+
+        return f
+
+    gg_lax = jax.grad(meta("lax"))(w)
+    gg_im = jax.grad(meta("im2col"))(w)
+    # double differentiation amplifies accumulation-order noise; the two
+    # lowerings contract in different orders
+    np.testing.assert_allclose(gg_lax, gg_im, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("hw", [(14, 14), (7, 7), (7, 9)])
+def test_max_pool_reshape_matches_reduce_window(hw):
+    """The reshape-max fast path must equal the reduce_window formulation,
+    including VALID's drop of trailing odd rows/cols."""
+    h, w = hw
+    x = _rand((3, h, w, 5), 7)
+    fast = F.max_pool2d(x)  # window == stride == 2 -> reshape path
+    ref = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(ref))
+
+
+def test_max_pool_gradient_matches_reduce_window():
+    x = _rand((2, 8, 8, 3), 8)
+
+    def f_fast(x):
+        return jnp.sum(F.max_pool2d(x) ** 2)
+
+    def f_ref(x):
+        return jnp.sum(
+            jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+            ** 2
+        )
+
+    # continuous random input: ties have probability zero, so the two
+    # formulations route identical gradients
+    np.testing.assert_allclose(
+        jax.grad(f_fast)(x), jax.grad(f_ref)(x), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_resolved_conv_impl_auto():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.conv_impl == "auto"
+    # tests run on the CPU backend (conftest) -> auto resolves to im2col
+    assert cfg.resolved_conv_impl == "im2col"
+    assert cfg.replace(conv_impl="lax").resolved_conv_impl == "lax"
