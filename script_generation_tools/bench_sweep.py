@@ -42,8 +42,13 @@ def main() -> None:
     args = ap.parse_args()
 
     grid = [("false", "full"), ("true", "full"), ("true", "save_conv")]
+    dtypes = ("float32", "bfloat16")
+    if os.environ.get("BENCH_SWEEP_GRID") == "smoke":
+        # CI/smoke mode: one remat point per dtype proves the subprocess
+        # plumbing without six compiles
+        grid = [("false", "full")]
     points = []
-    for dtype in ("float32", "bfloat16"):
+    for dtype in dtypes:
         for remat, policy in grid:
             ov = {
                 "BENCH_COMPUTE_DTYPE": dtype,

@@ -27,6 +27,7 @@ def main() -> None:
     ap.add_argument("--data_root", required=True)
     ap.add_argument("--exp_name", required=True)
     ap.add_argument("--cache_dir", required=True)
+    ap.add_argument("--total_epochs", type=int, default=2)
     args = ap.parse_args()
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -65,7 +66,8 @@ def main() -> None:
         "--second_order", "true",
         "--number_of_training_steps_per_iter", "2",
         "--number_of_evaluation_steps_per_iter", "2",
-        "--total_epochs", "2", "--total_iter_per_epoch", "2",
+        "--total_epochs", str(args.total_epochs),
+        "--total_iter_per_epoch", "2",
         "--num_evaluation_tasks", "8",
         "--num_dataprovider_workers", "2",
         "--cache_dir", args.cache_dir,

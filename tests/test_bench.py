@@ -109,3 +109,38 @@ def test_graft_entry_fn_jits_and_runs():
     n, s = cfg.num_classes_per_set, cfg.num_samples_per_class
     assert out.shape == (n * s, n)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_bench_sweep_runs_and_ranks():
+    """bench_sweep.py end-to-end on CPU with a clamped grid: the subprocess
+    plumbing, per-point env assembly, error tolerance, and ranked table must
+    be proven before the sweep gatekeeps real TPU time (round-3 verdict,
+    weak #3)."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_WARMUP_STEPS="1",
+        BENCH_BATCH_SIZE="2",
+        BENCH_CNN_NUM_FILTERS="8",
+        BENCH_IMAGE_HEIGHT="16",
+        BENCH_IMAGE_WIDTH="16",
+        BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER="2",
+        BENCH_NO_BASELINE_WRITE="1",
+        BENCH_SWEEP_GRID="smoke",  # 2 points instead of 6
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join("script_generation_tools", "bench_sweep.py"),
+            "--steps", "2", "--timeout", "420",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    assert out.returncode == 0, f"sweep failed:\n{out.stderr[-3000:]}"
+    assert "tasks/s/chip" in out.stdout  # table header printed
+    assert "best (" in out.stdout  # at least one point succeeded + ranked

@@ -146,10 +146,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_training(exp_name, data_root, cache_dir,
-                     num_processes, n_local_devices, timeout=900):
-    """Launch `num_processes` coordinated _mp_train_worker.py subprocesses
-    and return their outputs (raises on any non-zero exit)."""
+def _spawn_workers(exp_name, data_root, cache_dir,
+                   num_processes, n_local_devices, total_epochs=2):
+    """Spawn the coordinated worker gang without waiting (kill tests poll)."""
     import subprocess
     import sys as _sys
 
@@ -162,7 +161,7 @@ def _launch_training(exp_name, data_root, cache_dir,
     # workers own their XLA_FLAGS/JAX_PLATFORMS; drop the conftest's
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
+    return [
         subprocess.Popen(
             [
                 _sys.executable, worker,
@@ -173,6 +172,7 @@ def _launch_training(exp_name, data_root, cache_dir,
                 "--data_root", str(data_root),
                 "--exp_name", str(exp_name),
                 "--cache_dir", str(cache_dir),
+                "--total_epochs", str(total_epochs),
             ],
             env=env,
             cwd=repo,
@@ -182,6 +182,19 @@ def _launch_training(exp_name, data_root, cache_dir,
         )
         for pid in range(num_processes)
     ]
+
+
+def _launch_training(exp_name, data_root, cache_dir,
+                     num_processes, n_local_devices, timeout=900,
+                     total_epochs=2):
+    """Launch `num_processes` coordinated _mp_train_worker.py subprocesses
+    and return their outputs (raises on any non-zero exit)."""
+    import subprocess
+
+    procs = _spawn_workers(
+        exp_name, data_root, cache_dir, num_processes, n_local_devices,
+        total_epochs,
+    )
     # drain all pipes concurrently: a worker blocked on a full stdout pipe
     # inside a collective would deadlock the whole gang
     import concurrent.futures
@@ -276,3 +289,108 @@ def test_two_process_training_matches_single(tmp_path):
     for exp in (exp_multi, exp_single):
         saved = os.listdir(os.path.join(exp, "saved_models"))
         assert "train_model_latest" in saved and "train_model_2" in saved
+
+
+@pytest.mark.slow
+def test_two_process_kill_resume(tmp_path):
+    """SIGKILL a 2-process training gang after its epoch-1 checkpoint lands,
+    relaunch, and require the resumed run to finish and match an
+    uninterrupted single-process run's epoch stream — the multi-host
+    checkpoint write/swap barriers (experiment/checkpoint.py) must survive a
+    REAL unclean restart, not just a graceful exit."""
+    import subprocess
+    import time as _time
+
+    from test_e2e_presplit import _write_presplit_rgb
+
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root), n_classes=4, per_class=6, size=10)
+    exp = tmp_path / "exp_killed"
+    cache_dir = tmp_path / "cache"
+
+    # phase A targets MORE epochs than phase B so the gang cannot finish and
+    # exit cleanly before the kill lands (epochs are seconds here); the
+    # resume phase then completes the 2-epoch experiment from the survivor
+    # checkpoint
+    procs = _spawn_workers(
+        exp, data_root, cache_dir, num_processes=2, n_local_devices=4,
+        total_epochs=3,
+    )
+    # drain stdout continuously: a worker blocked on a full pipe inside a
+    # collective would deadlock the gang before the checkpoint ever lands
+    import io
+    import threading
+
+    bufs = [io.StringIO() for _ in procs]
+
+    def _drain(p, buf):
+        for line in p.stdout:
+            buf.write(line)
+
+    drainers = [
+        threading.Thread(target=_drain, args=(p, b), daemon=True)
+        for p, b in zip(procs, bufs)
+    ]
+    for t in drainers:
+        t.start()
+
+    # poll until the epoch-1 checkpoint AND its metrics row are durably on
+    # disk (checkpoint swap completes before pack_and_save_metrics writes
+    # the CSV, so header+row present => the whole epoch-1 persistence ran),
+    # then SIGKILL the gang mid-epoch-2
+    ckpt_dir = os.path.join(exp, "saved_models", "train_model_1")
+    csv_path = os.path.join(exp, "logs", "summary_statistics.csv")
+
+    def _epoch1_persisted():
+        if not os.path.isdir(ckpt_dir) or not os.path.exists(csv_path):
+            return False
+        with open(csv_path) as f:
+            return len([ln for ln in f.read().splitlines() if ln.strip()]) >= 2
+
+    deadline = _time.time() + 600
+    try:
+        while not _epoch1_persisted():
+            for p, b in zip(procs, bufs):
+                assert p.poll() is None, (
+                    f"worker died before epoch-1 persisted "
+                    f"(rc={p.returncode}):\n{b.getvalue()[-4000:]}"
+                )
+            assert _time.time() < deadline, "epoch 1 not persisted within 600s"
+            _time.sleep(0.5)
+    finally:
+        for p in procs:
+            p.kill()
+    for p in procs:
+        p.wait(timeout=60)
+    for t in drainers:
+        t.join(timeout=10)
+
+    # resume: a fresh gang on the same experiment dir must pick up from the
+    # latest checkpoint and complete the remaining epoch(s)
+    outs = _launch_training(
+        exp, data_root, cache_dir, num_processes=2, n_local_devices=4,
+        total_epochs=2,
+    )
+    assert any("WORKER_DONE process=0" in o for o in outs)
+    assert any("WORKER_DONE process=1" in o for o in outs)
+
+    # the resumed stream must equal an uninterrupted single-process run
+    exp_ref = tmp_path / "exp_uninterrupted"
+    _launch_training(
+        exp_ref, data_root, cache_dir, num_processes=1, n_local_devices=8,
+        total_epochs=2,
+    )
+    csv_res = _read_csv_columns(
+        os.path.join(exp, "logs", "summary_statistics.csv")
+    )
+    csv_ref = _read_csv_columns(
+        os.path.join(exp_ref, "logs", "summary_statistics.csv")
+    )
+    # epoch-2 row: trained AFTER the kill, on the fast-forwarded task stream
+    assert csv_res["epoch"][-1] == csv_ref["epoch"][-1] == 2
+    np.testing.assert_allclose(
+        csv_res["train_loss_mean"][-1], csv_ref["train_loss_mean"][-1],
+        atol=2e-3, err_msg="post-resume epoch diverged from uninterrupted run",
+    )
+    saved = os.listdir(os.path.join(exp, "saved_models"))
+    assert "train_model_latest" in saved and "train_model_2" in saved

@@ -20,8 +20,26 @@ def test_step_timer_stats():
     assert s["train_iters_per_sec"] > 0
     assert s["train_step_time_min_ms"] <= s["train_step_time_ms"]
     assert s["train_step_time_ms"] <= s["train_step_time_max_ms"]
+    # percentiles from the duration reservoir, ordered and bounded
+    assert (
+        s["train_step_time_min_ms"]
+        <= s["train_step_time_p50_ms"]
+        <= s["train_step_time_p95_ms"]
+        <= s["train_step_time_p99_ms"]
+        <= s["train_step_time_max_ms"]
+    )
     t.reset()
     assert t.summary() == {}
+
+
+def test_step_timer_reservoir_bounded():
+    t = StepTimer()
+    t.RESERVOIR = 8
+    for _ in range(100):
+        t.tick()
+    assert len(t._samples) == 8
+    assert t.count == 99
+    assert "train_step_time_p99_ms" in t.summary()
 
 
 def test_maybe_trace_disabled_is_noop():
