@@ -254,6 +254,12 @@ def main() -> None:
         overrides["compute_dtype"] = os.environ["BENCH_COMPUTE_DTYPE"]
     if "BENCH_REMAT_POLICY" in os.environ:
         overrides["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
+    # lowering knobs for hardware A/B runs: native conv vs im2col, batched
+    # vs sequential task axis (config validates the values)
+    if "BENCH_CONV_IMPL" in os.environ:
+        overrides["conv_impl"] = os.environ["BENCH_CONV_IMPL"]
+    if "BENCH_TASK_AXIS_MODE" in os.environ:
+        overrides["task_axis_mode"] = os.environ["BENCH_TASK_AXIS_MODE"]
     if "BENCH_USE_REMAT" in os.environ:
         raw = os.environ["BENCH_USE_REMAT"].lower()
         if raw not in ("true", "false", "0", "1"):
@@ -379,6 +385,8 @@ def main() -> None:
         "n_chips": n_chips,
         "dtype": cfg.compute_dtype,
         "batch_size": b,
+        "conv_impl": cfg.resolved_conv_impl,
+        "task_axis_mode": cfg.task_axis_mode,
         "reduced": reduced,
     }
     if baseline_backend is not None and not comparable:
