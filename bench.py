@@ -41,6 +41,9 @@ _CPU_FALLBACK_DEFAULTS = {
     "BENCH_IMAGE_HEIGHT": "28",
     "BENCH_IMAGE_WIDTH": "28",
     "BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER": "3",
+    # remat trades FLOPs for memory — right on HBM-bound MXUs, pure
+    # overhead on a CPU host (measured in .round4/SWEEP_CPU.txt)
+    "BENCH_USE_REMAT": "false",
 }
 
 # Peak dense-matmul FLOPs/chip by (device_kind substring, dtype).  bf16 rates
@@ -387,6 +390,8 @@ def main() -> None:
         "batch_size": b,
         "conv_impl": cfg.resolved_conv_impl,
         "task_axis_mode": cfg.task_axis_mode,
+        "use_remat": cfg.use_remat,
+        "remat_policy": cfg.remat_policy if cfg.use_remat else None,
         "reduced": reduced,
     }
     if baseline_backend is not None and not comparable:
