@@ -263,6 +263,8 @@ def main() -> None:
         overrides["conv_impl"] = os.environ["BENCH_CONV_IMPL"]
     if "BENCH_TASK_AXIS_MODE" in os.environ:
         overrides["task_axis_mode"] = os.environ["BENCH_TASK_AXIS_MODE"]
+    if "BENCH_POOL_IMPL" in os.environ:
+        overrides["pool_impl"] = os.environ["BENCH_POOL_IMPL"]
     if "BENCH_USE_REMAT" in os.environ:
         raw = os.environ["BENCH_USE_REMAT"].lower()
         if raw not in ("true", "false", "0", "1"):
@@ -294,7 +296,8 @@ def main() -> None:
             cfg.multi_step_loss_num_epochs,
         )
     )
-    if n_chips > 1 and cfg.batch_size % n_chips == 0:
+    sharded = n_chips > 1 and cfg.batch_size % n_chips == 0
+    if sharded:
         # shard the task axis so every chip actually works; tasks/s/chip is
         # then global throughput / chips
         from howtotrainyourmamlpytorch_tpu.parallel import mesh as mesh_lib
@@ -319,9 +322,20 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
         print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
 
+    def sync(m):
+        # A 4-byte scalar device_get is the one sync that provably blocks on
+        # every backend: over the remote-TPU tunnel, block_until_ready
+        # returns before execution finishes (measured: a timed loop "ran" at
+        # 40x hardware peak), so timing must anchor on a host fetch of a
+        # value that data-depends on the last step.
+        jax.block_until_ready(state.net)
+        if m is not None:
+            float(np.asarray(m["loss"]))
+
+    metrics = None  # BENCH_WARMUP_STEPS=0: nothing to sync yet
     for _ in range(warmup_steps):
         state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
-    jax.block_until_ready(state.net)
+    sync(metrics)
 
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     if trace_dir:
@@ -331,12 +345,16 @@ def main() -> None:
     start = time.perf_counter()
     for _ in range(timed_steps):
         state, metrics = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
-    jax.block_until_ready(state.net)
+    sync(metrics)
     elapsed = time.perf_counter() - start
     if trace_dir:
         jax.profiler.stop_trace()
 
-    tasks_per_sec = timed_steps * b / elapsed / n_chips
+    # per-chip = per *working* chip: when the batch didn't divide n_chips we
+    # ran unsharded on one device, and dividing by idle chips would both
+    # understate throughput and skew mfu away from hfu's working-device
+    # convention
+    tasks_per_sec = timed_steps * b / elapsed / (n_chips if sharded else 1)
 
     peak = _peak_flops(device_kind, cfg.compute_dtype)
     # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
@@ -349,14 +367,22 @@ def main() -> None:
         if peak
         else None
     )
-    # cost_analysis() is PER-DEVICE on a sharded executable: it counts the
-    # partitioned module, i.e. b / n_chips tasks' worth of work
+    # cost_analysis() is PER-DEVICE: on a sharded executable it counts the
+    # partitioned module (b / n_chips tasks' worth of work), but when the
+    # batch didn't divide the chips we ran unsharded and it covers all b
+    tasks_per_executable = b / n_chips if sharded else b
     xla_flops_per_task = (
-        xla_flops_per_batch / (b / n_chips) if xla_flops_per_batch else None
+        xla_flops_per_batch / tasks_per_executable
+        if xla_flops_per_batch
+        else None
     )
+    # hfu: executed FLOPs per second on a working device over peak.
+    # xla_flops_per_batch is already the per-device module count, and the
+    # per-device module runs once per step whether or not the batch was
+    # sharded — so this form needs no sharded/unsharded correction.
     hfu = (
-        round(tasks_per_sec * xla_flops_per_task / peak, 4)
-        if peak and xla_flops_per_task
+        round(timed_steps * xla_flops_per_batch / elapsed / peak, 4)
+        if peak and xla_flops_per_batch
         else None
     )
 
@@ -389,6 +415,7 @@ def main() -> None:
         "dtype": cfg.compute_dtype,
         "batch_size": b,
         "conv_impl": cfg.resolved_conv_impl,
+        "pool_impl": cfg.resolved_pool_impl,
         "task_axis_mode": cfg.task_axis_mode,
         "use_remat": cfg.use_remat,
         "remat_policy": cfg.remat_policy if cfg.use_remat else None,

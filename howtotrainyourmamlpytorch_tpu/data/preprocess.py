@@ -37,9 +37,16 @@ import contextlib
 import glob
 import json
 import os
+import time
 from typing import Dict, List
 
 import numpy as np
+
+# temps older than this are swept even if their embedded pid looks alive:
+# the pid may be reused by an unrelated process, or belong to another host
+# on shared storage. Active builders rewrite their memmap continuously, so
+# hours-old mtime means abandoned. Overridable for tests.
+_STALE_TEMP_AGE_S = float(os.environ.get("MAML_STALE_TEMP_AGE_S", 6 * 3600))
 
 from ..config import MAMLConfig
 from .datasets import ClassIndex
@@ -103,15 +110,34 @@ def build_set_cache(
         # behind forever (finally never ran); sweep stale ones for this cache
         # base before building. A concurrent builder's temp is LIVE, not
         # stale — deleting it would unlink the file under its memmap and
-        # crash its os.replace — so only remove temps whose pid is dead.
+        # crash its os.replace — so only remove temps whose pid is provably
+        # dead (ProcessLookupError). EPERM means the pid EXISTS under another
+        # uid: treat as alive. Pid liveness is host-local and pids get
+        # reused, so additionally remove temps untouched for
+        # _STALE_TEMP_AGE_S regardless of pid — covers remote builders on
+        # shared storage and pid-reuse leaks; a live builder's memmap writes
+        # keep refreshing its temp's mtime long before that threshold.
+        now = time.time()
         for path_base in (data_path, meta_path):
             for stale in glob.glob(f"{path_base}.tmp.*"):
                 try:
                     pid = int(stale.rsplit(".", 1)[-1])
-                    os.kill(pid, 0)  # raises if no such process
                 except ValueError:
                     continue  # unrecognized suffix: leave it alone
-                except OSError:
+                dead = False
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    dead = True
+                except OSError:  # EPERM et al.: process exists
+                    pass
+                if not dead:
+                    try:
+                        age = now - os.path.getmtime(stale)
+                    except OSError:
+                        continue  # vanished under us: nothing to clean
+                    dead = age > _STALE_TEMP_AGE_S
+                if dead:
                     with contextlib.suppress(OSError):
                         os.remove(stale)
         data_tmp = f"{data_path}.tmp.{os.getpid()}"
@@ -126,6 +152,7 @@ def build_set_cache(
                 for j, path in enumerate(classes[key]):
                     jobs.append((offset + j, path))
                 offset += count
+            last_touch = time.monotonic()
             with concurrent.futures.ThreadPoolExecutor(workers) as pool:
                 for idx, arr in pool.map(
                     lambda job: (job[0], load_image_uint8(cfg, job[1])),
@@ -133,14 +160,40 @@ def build_set_cache(
                     chunksize=64,
                 ):
                     mm[idx] = arr
+                    # memmap stores don't reliably refresh mtime (mmap
+                    # writes bypass the file API; NFS especially) — touch
+                    # explicitly so the age-based stale sweep above sees a
+                    # live build as live
+                    if time.monotonic() - last_touch > 60:
+                        with contextlib.suppress(OSError):
+                            os.utime(data_tmp)
+                        last_touch = time.monotonic()
             mm.flush()
             del mm
-            os.replace(data_tmp, data_path)
-            with open(meta_tmp, "w") as f:
-                json.dump(
-                    {"classes": order, "counts": counts, "done": True}, f
-                )
-            os.replace(meta_tmp, meta_path)
+            ours_landed = True
+            try:
+                os.replace(data_tmp, data_path)
+            except FileNotFoundError:
+                # our temp was swept as stale (e.g. this process sat
+                # SIGSTOPped past the age threshold while another builder
+                # rebuilt the cache). If a right-sized data file is in
+                # place, serve it for THIS call only — but do NOT stamp the
+                # done meta for a file we didn't write: that would bless a
+                # size-matching-but-garbage file forever. The concurrent
+                # builder stamps its own meta; absent that, the next call
+                # revalidates and rebuilds.
+                ours_landed = False
+                if not (
+                    os.path.exists(data_path)
+                    and os.path.getsize(data_path) == total * h * w * c
+                ):
+                    raise
+            if ours_landed:
+                with open(meta_tmp, "w") as f:
+                    json.dump(
+                        {"classes": order, "counts": counts, "done": True}, f
+                    )
+                os.replace(meta_tmp, meta_path)
         finally:
             for tmp in (data_tmp, meta_tmp):
                 with contextlib.suppress(FileNotFoundError):

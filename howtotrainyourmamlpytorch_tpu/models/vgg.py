@@ -205,7 +205,7 @@ def apply(
             out = apply_norm(out, i)
         out = F.leaky_relu(out)
         if cfg.max_pooling:
-            out = F.max_pool2d(out)
+            out = F.max_pool2d(out, impl=cfg.resolved_pool_impl)
 
     if not cfg.max_pooling:
         out = F.global_avg_pool2d(out)

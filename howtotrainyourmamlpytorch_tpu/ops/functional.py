@@ -105,16 +105,23 @@ def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray]) -> jnp.ndar
     return out
 
 
-def max_pool2d(x: jnp.ndarray, window: int = 2, stride: int = 2) -> jnp.ndarray:
+def max_pool2d(
+    x: jnp.ndarray, window: int = 2, stride: int = 2, impl: str = "reshape"
+) -> jnp.ndarray:
     """2x2 max pool, NHWC (ref: F.max_pool2d, meta_...py:605,652).
 
-    For the window == stride case (the only one the backbone uses) the pool
-    is a reshape + max over the tile axes — identical values to the
-    reduce_window formulation (VALID: trailing odd rows/cols dropped), but
-    its gradient is an elementwise mask instead of XLA's select-and-scatter,
-    which profiles ~10x slower on CPU and is no better on TPU.
+    Two numerically identical lowerings (VALID: trailing odd rows/cols
+    dropped), selected per backend by ``config.resolved_pool_impl``:
+
+    * ``reshape`` (window == stride only): reshape + max over the tile
+      axes — its gradient is an elementwise mask instead of XLA's
+      select-and-scatter, which profiles ~10x slower on CPU;
+    * ``reduce_window``: XLA's native window reduce — on TPU the reshape
+      form's (.., ho, 2, wo, 2, c) intermediate is tile-padded ~3.4x in
+      HBM (measured: it OOMs the no-remat 84x84 path), while
+      reduce_window fuses with no blown-up temp.
     """
-    if window == stride:
+    if impl == "reshape" and window == stride:
         n, h, w, c = x.shape
         ho, wo = h // window, w // window
         x = x[:, : ho * window, : wo * window, :]

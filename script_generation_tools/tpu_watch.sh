@@ -19,8 +19,8 @@
 
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-ARTIFACT_DIR="${ARTIFACT_DIR:-$REPO/.round4}"
-CPU_TRAIN_PIDFILE="${CPU_TRAIN_PIDFILE:-/tmp/round4_cpu_train.pid}"
+ARTIFACT_DIR="${ARTIFACT_DIR:-$REPO/.round5}"
+CPU_TRAIN_PIDFILE="${CPU_TRAIN_PIDFILE:-/tmp/round5_cpu_train.pid}"
 PROBE_INTERVAL="${PROBE_INTERVAL:-600}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-240}"
 LOG="$ARTIFACT_DIR/tpu_watch.log"
@@ -41,10 +41,10 @@ cpu_trainer_signal() {  # STOP or CONT the registered CPU trainer, if any
 
 probe() {  # 0 iff the default backend is a real TPU
     local out rc
-    out=$(timeout "$PROBE_TIMEOUT" python -c \
+    out=$(set -o pipefail; timeout "$PROBE_TIMEOUT" python -c \
         "import jax; d=jax.devices(); print(d[0].platform, d[0].device_kind, len(d))" \
         2>/dev/null | tail -1)
-    rc=${PIPESTATUS[0]}  # timeout/python status, not tail's
+    rc=$?  # pipefail inside the substitution: timeout/python status wins
     say "probe: ${out:-DOWN(rc=$rc; 124=timeout)}"
     [[ "$out" == tpu* ]]
 }

@@ -102,3 +102,24 @@ def test_resolved_conv_impl_auto():
     # tests run on the CPU backend (conftest) -> auto resolves to im2col
     assert cfg.resolved_conv_impl == "im2col"
     assert cfg.replace(conv_impl="lax").resolved_conv_impl == "lax"
+
+
+def test_max_pool_impl_flag_equivalence():
+    """impl='reduce_window' must produce the same values as the reshape fast
+    path (it is what resolved_pool_impl selects on TPU backends)."""
+    x = _rand((3, 9, 7, 5), 11)
+    np.testing.assert_array_equal(
+        np.asarray(F.max_pool2d(x, impl="reshape")),
+        np.asarray(F.max_pool2d(x, impl="reduce_window")),
+    )
+
+
+def test_resolved_pool_impl_auto_and_validation():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.resolved_pool_impl == "reshape"  # tests run on CPU
+    cfg = MAMLConfig(dataset_name="omniglot_dataset", pool_impl="reduce_window")
+    assert cfg.resolved_pool_impl == "reduce_window"
+    with pytest.raises(ValueError, match="pool_impl"):
+        MAMLConfig(dataset_name="omniglot_dataset", pool_impl="bogus")

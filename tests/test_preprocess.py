@@ -2,6 +2,8 @@
 
 import json
 import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -161,3 +163,42 @@ def test_half_written_cache_not_served(tmp_path):
         f.write(b"\x00" * size)
     b2 = _first_batches(cfg, n=1)
     np.testing.assert_array_equal(b1[0][0], b2[0][0])
+
+
+def test_stale_temp_sweep_pid_and_age(tmp_path):
+    """The pre-build sweep removes dead-pid and over-age temps, keeps a live
+    builder's fresh temp (incl. the EPERM 'exists but not ours' case, which
+    os.kill reports for pid 1 when unprivileged)."""
+    root = tmp_path / "data"
+    _write_dataset(str(root), n_classes=10, per_class=4, size=8, mode="1")
+    cfg = _cfg(
+        root, tmp_path / "cache", dataset_name="omniglot_dataset",
+        image_height=8, image_width=8, image_channels=1, use_mmap_cache=True,
+    )
+    os.makedirs(cfg.cache_dir, exist_ok=True)
+    base = preprocess._cache_base(cfg, cfg.cache_dir, "train")
+    dead_pid = 2 ** 22 + 7  # above any real pid on this host
+    # the live same-uid process must NOT be os.getpid() (that is the
+    # in-process builder's own temp name, which its finally-cleanup removes)
+    # nor os.getppid() (pid 1 when the runner is a container's init child,
+    # colliding with live_old below) — spawn a throwaway child instead
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"]
+    )
+    try:
+        live_fresh = f"{base}.u8.tmp.{child.pid}"
+        live_old = f"{base}.u8.tmp.1"  # pid 1: os.kill -> EPERM when unprivileged
+        dead = f"{base}.u8.tmp.{dead_pid}"
+        for p in (live_fresh, live_old, dead):
+            with open(p, "w") as f:
+                f.write("x")
+        old = preprocess._STALE_TEMP_AGE_S + 60
+        os.utime(live_old, (os.path.getmtime(live_old) - old,) * 2)
+        _first_batches(cfg, n=1)  # triggers the sweep, then builds
+        assert os.path.exists(live_fresh), "fresh live-pid temp must survive"
+        assert not os.path.exists(live_old), "over-age temp swept despite live pid"
+        assert not os.path.exists(dead), "dead-pid temp swept"
+        os.remove(live_fresh)
+    finally:
+        child.kill()
+        child.wait()
