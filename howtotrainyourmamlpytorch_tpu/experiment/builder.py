@@ -36,7 +36,7 @@ from ..utils.storage import (
     save_statistics,
     save_to_json,
 )
-from .checkpoint import checkpoint_exists
+from .checkpoint import checkpoint_exists, remove_checkpoint
 from .system import MAMLFewShotClassifier
 
 
@@ -332,6 +332,16 @@ class ExperimentBuilder:
                     self.state.update(train_losses)
                     self.state.update(val_losses)
 
+                    # metrics BEFORE the checkpoint writes (deliberate
+                    # divergence from the reference's :352-365 order): the
+                    # epoch-N checkpoint must carry its own epoch's
+                    # per_epoch_statistics row, or a resumed run's stat rows
+                    # shift one checkpoint out of register — misranking the
+                    # final ensemble and, worse, mis-PRUNING checkpoints.
+                    # Worst crash case now is a duplicate CSV row for a
+                    # re-trained epoch (cosmetic) instead of a permanently
+                    # missing stat row (corrupting).
+                    self.pack_and_save_metrics(train_losses, val_losses)
                     # dual checkpoint: epoch-numbered + latest (:190-206)
                     self.model.save_model(
                         self.saved_models_filepath, int(self.epoch), self.state
@@ -339,7 +349,7 @@ class ExperimentBuilder:
                     self.model.save_model(
                         self.saved_models_filepath, "latest", self.state
                     )
-                    self.pack_and_save_metrics(train_losses, val_losses)
+                    self._prune_saved_models()
                     self.total_losses = {}
                     self._pbar_sums = {}
                     self.epochs_done_in_this_run += 1
@@ -363,9 +373,39 @@ class ExperimentBuilder:
             self._close_pbar()
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
 
+    def _prune_saved_models(self) -> None:
+        """Honor ``max_models_to_save`` (config.py — the reference parses it
+        but never acts on it, keeping every epoch's checkpoint on disk,
+        experiment_builder.py:190-206).  Keep ``latest`` plus the top-K
+        epochs by validation accuracy — the same ``argsort`` ranking the
+        final top-5 ensemble uses (``evaluated_test_set_using_the_best_
+        models``), so pruning can never delete a checkpoint the ensemble
+        will ask for as long as K >= its ``top_n_models``.  K <= 0 disables
+        pruning.
+        """
+        k = int(self.cfg.max_models_to_save)
+        if k <= 0 or not self.is_primary:
+            return
+        val_acc = np.asarray(
+            self.state["per_epoch_statistics"]["val_accuracy_mean"],
+            dtype=float,
+        )
+        # stat row i corresponds to checkpoint i+1 (1-based epoch counter at
+        # save time — the ensemble's model_idx + 1 mapping)
+        keep = {int(i) + 1 for i in np.argsort(val_acc)[::-1][:k]}
+        for epoch_idx in range(1, len(val_acc) + 1):
+            if epoch_idx not in keep:
+                remove_checkpoint(
+                    self.saved_models_filepath, "train_model", epoch_idx
+                )
+
     # -- final test ensemble (experiment_builder.py:247-300) --------------
 
     def evaluated_test_set_using_the_best_models(self, top_n_models: int = 5):
+        if self.cfg.max_models_to_save > 0:
+            # pruning kept only the top-K epoch checkpoints; asking the
+            # ensemble for more would load checkpoints that no longer exist
+            top_n_models = min(top_n_models, int(self.cfg.max_models_to_save))
         per_epoch = self.state["per_epoch_statistics"]
         val_acc = np.copy(per_epoch["val_accuracy_mean"])
         sorted_idx = np.argsort(val_acc, axis=0).astype(np.int32)[::-1][:top_n_models]

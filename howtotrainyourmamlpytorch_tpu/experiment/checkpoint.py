@@ -121,3 +121,16 @@ def load_checkpoint(
 
 def checkpoint_exists(model_save_dir: str, model_name: str, model_idx) -> bool:
     return os.path.isdir(_ckpt_dir(model_save_dir, model_name, model_idx))
+
+
+def remove_checkpoint(model_save_dir: str, model_name: str, model_idx) -> None:
+    """Delete one checkpoint directory; missing is fine.
+
+    Multi-host: only the primary touches the shared filesystem (no barrier
+    needed — pruning is best-effort hygiene, never load-bearing).
+    """
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return
+    shutil.rmtree(
+        _ckpt_dir(model_save_dir, model_name, model_idx), ignore_errors=True
+    )

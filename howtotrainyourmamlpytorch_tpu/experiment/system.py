@@ -27,17 +27,45 @@ from ..parallel import mesh as mesh_lib
 from . import checkpoint as ckpt
 
 
-def _to_nhwc(x: np.ndarray) -> np.ndarray:
+def _to_nhwc(
+    x: np.ndarray,
+    layout: str = "auto",
+    im_shape: Optional[Tuple[int, int, int]] = None,
+) -> np.ndarray:
     """Accept reference-layout (..., c, h, w) batches and convert to NHWC.
 
-    Heuristic: channels axis is -3 when it is 1 or 3 and trailing two dims
-    are equal (h == w for both supported datasets).
+    ``layout`` is ``cfg.input_layout``: 'nhwc' and 'nchw' are explicit and
+    never guess; 'auto' first matches the trailing three dims against the
+    config's ``im_shape`` (h, w, c) — exact and unambiguous whenever the
+    batch is the configured dataset — then falls back to a channels-position
+    heuristic (channels is whichever of dim -1 / dim -3 is 1 or 3), erroring
+    when both positions qualify with different results or neither does.
     """
-    if x.shape[-1] in (1, 3):
+    if layout == "nhwc":
         return x
-    if x.shape[-3] in (1, 3):
+    if layout == "nchw":
         return np.moveaxis(x, -3, -1)
-    raise ValueError(f"cannot infer layout of batch with shape {x.shape}")
+    if im_shape is not None:
+        h, w, c = im_shape
+        if x.shape[-3:] == (h, w, c):
+            return x
+        if x.shape[-3:] == (c, h, w) and (h, w, c) != (c, h, w):
+            return np.moveaxis(x, -3, -1)
+    nhwc_like = x.shape[-1] in (1, 3)
+    nchw_like = x.shape[-3] in (1, 3)
+    if nhwc_like and nchw_like:
+        raise ValueError(
+            f"batch shape {x.shape} is ambiguous between NHWC and NCHW; "
+            "set input_layout='nhwc' or 'nchw' in the config"
+        )
+    if nhwc_like:
+        return x
+    if nchw_like:
+        return np.moveaxis(x, -3, -1)
+    raise ValueError(
+        f"cannot infer layout of batch with shape {x.shape}; "
+        "set input_layout='nhwc' or 'nchw' in the config"
+    )
 
 
 class MAMLFewShotClassifier:
@@ -112,8 +140,9 @@ class MAMLFewShotClassifier:
 
     def _prepare_batch(self, data_batch):
         x_s, x_t, y_s, y_t = data_batch[:4]
-        x_s = _to_nhwc(np.asarray(x_s, np.float32))
-        x_t = _to_nhwc(np.asarray(x_t, np.float32))
+        layout, shape = self.cfg.input_layout, self.cfg.im_shape
+        x_s = _to_nhwc(np.asarray(x_s, np.float32), layout, shape)
+        x_t = _to_nhwc(np.asarray(x_t, np.float32), layout, shape)
         y_s = np.asarray(y_s, np.int32)
         y_t = np.asarray(y_t, np.int32)
         if self.multihost:

@@ -98,3 +98,53 @@ def test_presplit_rgb_end_to_end(tmp_path):
         os.path.join(builder3.logs_filepath, "summary_statistics.csv")
     ).read().count("\n") == csv_rows_before
     assert 0.0 <= test_only["test_accuracy_mean"] <= 1.0
+
+
+def test_max_models_to_save_prunes_checkpoints(tmp_path):
+    """max_models_to_save=K keeps `latest` + the top-K epochs by val
+    accuracy, and the final ensemble still finds its checkpoints (the
+    reference parses the key but never prunes)."""
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root))
+    cfg = MAMLConfig(
+        experiment_name=str(tmp_path / "exp"),
+        dataset_name="mini_imagenet_full_size",
+        dataset_path=str(data_root),
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=10, image_width=10, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+        batch_size=2, cnn_num_filters=4, num_stages=2, max_pooling=True,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        total_epochs=4, total_iter_per_epoch=2, num_evaluation_tasks=4,
+        total_epochs_before_pause=100,
+        num_dataprovider_workers=2, cache_dir=str(tmp_path / "cache"),
+        use_mmap_cache=True, use_remat=False, seed=0,
+        max_models_to_save=2,
+    )
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    builder = ExperimentBuilder(
+        cfg, model, MetaLearningDataLoader,
+        experiment_root=str(tmp_path), verbose=False,
+    )
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+    saved = set(os.listdir(builder.saved_models_filepath))
+    assert "train_model_latest" in saved
+    epoch_ckpts = saved - {"train_model_latest"}
+    assert len(epoch_ckpts) == 2
+    # builder.state was rewritten by the ensemble's checkpoint loads; the
+    # CSV holds the full 4-epoch val history
+    import csv
+
+    with open(
+        os.path.join(builder.logs_filepath, "summary_statistics.csv")
+    ) as f:
+        rows = list(csv.DictReader(f))
+    val = np.asarray([float(r["val_accuracy_mean"]) for r in rows])
+    assert len(val) == 4
+    expected = {
+        f"train_model_{int(i) + 1}" for i in np.argsort(val)[::-1][:2]
+    }
+    assert epoch_ckpts == expected

@@ -79,6 +79,24 @@ def test_to_nhwc_accepts_both_layouts(tiny_cfg):
         _to_nhwc(np.zeros((2, 3, 5, 7, 9), np.float32))
 
 
+def test_to_nhwc_explicit_layout_never_guesses():
+    # a 3xHxW image whose W == 3: the heuristic alone is ambiguous
+    ambiguous = np.zeros((2, 4, 3, 5, 3), np.float32)
+    with pytest.raises(ValueError, match="ambiguous"):
+        _to_nhwc(ambiguous)
+    assert _to_nhwc(ambiguous, layout="nhwc").shape == (2, 4, 3, 5, 3)
+    assert _to_nhwc(ambiguous, layout="nchw").shape == (2, 4, 5, 3, 3)
+    # the config's im_shape disambiguates in auto mode
+    assert _to_nhwc(ambiguous, im_shape=(3, 5, 3)).shape == (2, 4, 3, 5, 3)
+    assert _to_nhwc(ambiguous, im_shape=(5, 3, 3)).shape == (2, 4, 5, 3, 3)
+
+
+def test_input_layout_config_validated(tiny_cfg):
+    with pytest.raises(ValueError, match="input_layout"):
+        tiny_cfg.replace(input_layout="bogus")
+    assert tiny_cfg.replace(input_layout="nchw").input_layout == "nchw"
+
+
 def test_validation_iter_returns_preds_only_on_request(tiny_cfg):
     model = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
     losses, preds = model.run_validation_iter(_batch(tiny_cfg))
