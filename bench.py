@@ -465,9 +465,9 @@ def main() -> None:
     # knobs (e.g. the round-4 fp32/batch-8 record after the bf16/batch-12
     # defaults landed) is stale, not a comparison point.
     _COMPARABLE_KEYS = (
-        "backend", "dtype", "batch_size", "conv_impl", "pool_impl",
-        "task_axis_mode", "use_remat", "remat_policy", "matmul_precision",
-        "workload",
+        "backend", "dtype", "batch_size", "n_chips", "conv_impl",
+        "pool_impl", "task_axis_mode", "use_remat", "remat_policy",
+        "matmul_precision", "workload",
     )
     comparable = (
         baseline_rec is not None

@@ -391,8 +391,17 @@ class ExperimentBuilder:
             dtype=float,
         )
         # stat row i corresponds to checkpoint i+1 (1-based epoch counter at
-        # save time — the ensemble's model_idx + 1 mapping)
-        keep = {int(i) + 1 for i in np.argsort(val_acc)[::-1][:k]}
+        # save time — the ensemble's model_idx + 1 mapping). kind='stable' +
+        # reverse = ties broken toward the LATER epoch, identically in every
+        # prune and in the final ensemble ranking; an unstable sort could
+        # order tied epochs differently between the epoch-N prune and the
+        # final length-M ranking and delete a checkpoint the ensemble then
+        # asks for (val accuracies are quantized to 1/num_evaluation_tasks,
+        # so exact ties are common)
+        keep = {
+            int(i) + 1
+            for i in np.argsort(val_acc, kind="stable")[::-1][:k]
+        }
         for epoch_idx in range(1, len(val_acc) + 1):
             if epoch_idx not in keep:
                 remove_checkpoint(
@@ -408,7 +417,12 @@ class ExperimentBuilder:
             top_n_models = min(top_n_models, int(self.cfg.max_models_to_save))
         per_epoch = self.state["per_epoch_statistics"]
         val_acc = np.copy(per_epoch["val_accuracy_mean"])
-        sorted_idx = np.argsort(val_acc, axis=0).astype(np.int32)[::-1][:top_n_models]
+        # kind='stable': must break ties exactly like _prune_saved_models
+        # (see there) so a pruned run's surviving checkpoints are the ones
+        # ranked here
+        sorted_idx = np.argsort(val_acc, axis=0, kind="stable").astype(
+            np.int32
+        )[::-1][:top_n_models]
         self._log(f"top-{top_n_models} val epochs {sorted_idx} acc {val_acc[sorted_idx]}")
 
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)

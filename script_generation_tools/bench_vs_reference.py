@@ -68,6 +68,7 @@ def time_ours(a) -> float:
         number_of_evaluation_steps_per_iter=a.steps,
         use_remat=a.remat,
         task_axis_mode=a.task_mode,
+        conv_impl=a.conv_impl,
     )
     state = maml.init_state(cfg)
     x_s, x_t, y_s, y_t = _task_batch(
@@ -81,13 +82,20 @@ def time_ours(a) -> float:
     step = jax.jit(
         maml.make_train_step(cfg, second_order=True), donate_argnums=(0,)
     )
+    def sync(m):
+        # scalar fetch of a value data-dependent on the last step: over the
+        # remote-TPU tunnel block_until_ready returns before execution
+        # finishes (same rationale as bench.py's sync)
+        jax.block_until_ready(state.net)
+        float(np.asarray(m["loss"]))
+
     for _ in range(2):  # compile + settle
         state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
-    jax.block_until_ready(state.net)
+    sync(m)
     t0 = time.perf_counter()
     for _ in range(a.timed):
         state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
-    jax.block_until_ready(state.net)
+    sync(m)
     return a.timed * a.batch / (time.perf_counter() - t0)
 
 
@@ -195,6 +203,11 @@ def main() -> None:
         "--task-mode", default="map", choices=("vmap", "map"),
         help="'map' (sequential tasks, ordinary convs) is the CPU-host fast "
         "path; 'vmap' is the TPU default (grouped convs for the MXU)",
+    )
+    ap.add_argument(
+        "--conv-impl", default="auto", choices=("auto", "lax", "im2col"),
+        help="conv lowering for our half (config.conv_impl); 'auto' picks "
+        "im2col on CPU",
     )
     ap.add_argument("--skip-reference", action="store_true")
     a = ap.parse_args()

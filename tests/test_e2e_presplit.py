@@ -145,6 +145,7 @@ def test_max_models_to_save_prunes_checkpoints(tmp_path):
     val = np.asarray([float(r["val_accuracy_mean"]) for r in rows])
     assert len(val) == 4
     expected = {
-        f"train_model_{int(i) + 1}" for i in np.argsort(val)[::-1][:2]
+        f"train_model_{int(i) + 1}"
+        for i in np.argsort(val, kind="stable")[::-1][:2]
     }
     assert epoch_ckpts == expected
