@@ -1,0 +1,37 @@
+#!/bin/bash
+set -u
+cd /root/repo
+echo "== proxy 48f flagship training (pause after 30 epochs)"
+DATASET_DIR=/root/repo/.round5/proxy_data timeout 7200 python train_maml_system.py \
+  --experiment_name .round5/experiments/proxy_48f_5way5shot \
+  --dataset_name mini_imagenet_full_size --dataset_path mini_imagenet_full_size \
+  --sets_are_pre_split true --load_into_memory false \
+  --indexes_of_folders_indicating_class "[-3, -2]" \
+  --image_height 84 --image_width 84 --image_channels 3 \
+  --num_classes_per_set 5 --num_samples_per_class 5 --num_target_samples 15 \
+  --batch_size 2 --cnn_num_filters 48 --num_stages 4 --max_pooling true \
+  --per_step_bn_statistics true \
+  --learnable_per_layer_per_step_inner_loop_learning_rate true \
+  --use_multi_step_loss_optimization true --second_order true \
+  --number_of_training_steps_per_iter 5 --number_of_evaluation_steps_per_iter 5 \
+  --total_epochs 500 --total_iter_per_epoch 100 --multi_step_loss_num_epochs 75 \
+  --num_evaluation_tasks 40 --total_epochs_before_pause 30 \
+  --use_mmap_cache true --compilation_cache_dir .round5/xla_cache --seed 0 \
+  > .round5/train_proxy48f.log 2>&1
+echo "proxy training rc=$?"
+echo "== resume 20-way 64f"
+DATASET_DIR=/root/reference nohup python train_maml_system.py \
+  --experiment_name .round5/experiments/omniglot_20way_64f \
+  --dataset_name omniglot_dataset --dataset_path datasets/omniglot_dataset \
+  --train_val_test_split "[0.70918052988, 0.03080714725, 0.2606284658]" \
+  --num_classes_per_set 20 --num_samples_per_class 1 --num_target_samples 1 \
+  --batch_size 8 --cnn_num_filters 64 --num_stages 4 --max_pooling true \
+  --per_step_bn_statistics true \
+  --learnable_per_layer_per_step_inner_loop_learning_rate true \
+  --use_multi_step_loss_optimization true --second_order true \
+  --number_of_training_steps_per_iter 5 --number_of_evaluation_steps_per_iter 5 \
+  --total_epochs 500 --total_iter_per_epoch 100 --multi_step_loss_num_epochs 50 \
+  --num_evaluation_tasks 40 --total_epochs_before_pause 400 \
+  --use_mmap_cache true --compilation_cache_dir .round5/xla_cache --seed 0 \
+  >> .round5/train20_tpu_hp.log 2>&1 &
+echo "20-way resumed pid $!"
