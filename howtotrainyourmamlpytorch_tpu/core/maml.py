@@ -317,6 +317,35 @@ def make_train_step(cfg: MAMLConfig, second_order: bool):
     return train_step
 
 
+def make_train_multi_step(cfg: MAMLConfig, second_order: bool):
+    """K outer updates in ONE compiled program: ``lax.scan`` over a leading
+    batch-of-batches axis (config ``steps_per_dispatch``).
+
+    Signature: (state, x_s, y_s, x_t, y_t, loss_weights, lr) ->
+    (state, metrics) where every batch argument carries a leading k axis and
+    the metrics come back stacked (k,).
+
+    Why: each dispatch over a networked device transport (the remote-TPU
+    tunnel) costs a host round-trip that can dwarf device compute — measured
+    ~0.5 s/dispatch against ~30 ms of compute for the paper-width Omniglot
+    step, capping training at ~1.8 iters/s with the chip 95% idle. One
+    upload + one dispatch per K steps amortizes that. LR, MSL weights and
+    the order flag are epoch-functions and therefore constant within a
+    chunk; the experiment builder flushes chunks at epoch boundaries.
+    """
+    step = make_train_step(cfg, second_order)
+
+    def multi_step(state, x_s, y_s, x_t, y_t, loss_weights, lr):
+        def body(st, batch):
+            xs, ys, xt, yt = batch
+            st, metrics = step(st, xs, ys, xt, yt, loss_weights, lr)
+            return st, metrics
+
+        return jax.lax.scan(body, state, (x_s, y_s, x_t, y_t))
+
+    return multi_step
+
+
 def make_eval_step(cfg: MAMLConfig):
     """Build the jitted evaluation step.
 

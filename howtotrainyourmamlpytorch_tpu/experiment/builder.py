@@ -103,6 +103,7 @@ class ExperimentBuilder:
         self._active_pbar = None
         self._pbar_sums: Dict[str, tuple] = {}
         self._tracing = False
+        self._profile_done = False
         self._steps_this_run = 0
         # multi-host: checkpoint saves are collective (orbax), but metric
         # files are written by the primary process only
@@ -121,7 +122,11 @@ class ExperimentBuilder:
         if summary_losses is None:
             summary_losses = {}
         for key in total_losses:
-            vals = np.asarray([np.asarray(v) for v in total_losses[key]])
+            # entries are per-iteration scalars OR (k,)-stacked chunk
+            # arrays (steps_per_dispatch) — flatten to one value stream
+            vals = np.concatenate(
+                [np.atleast_1d(np.asarray(v)) for v in total_losses[key]]
+            )
             summary_losses[f"{phase}_{key}_mean"] = float(np.mean(vals))
             summary_losses[f"{phase}_{key}_std"] = float(np.std(vals))
         return summary_losses
@@ -152,12 +157,16 @@ class ExperimentBuilder:
         call, which made the per-tick postfix O(n²) over an epoch; this
         consumes only the entries appended since the previous tick."""
         for key, vals in total_losses.items():
-            s, n = sums.get(key, (0.0, 0))
-            for v in vals[n:]:
-                s += float(np.asarray(v))
-                n += 1
-            sums[key] = (s, n)
-        return {f"{phase}_{k}_mean": s / n for k, (s, n) in sums.items() if n}
+            s, n, seen = sums.get(key, (0.0, 0, 0))
+            for v in vals[seen:]:
+                a = np.atleast_1d(np.asarray(v))  # chunked entries are (k,)
+                s += float(a.sum())
+                n += a.size
+                seen += 1
+            sums[key] = (s, n, seen)
+        return {
+            f"{phase}_{k}_mean": s / n for k, (s, n, _) in sums.items() if n
+        }
 
     @staticmethod
     def _pbar_tick(pbar, summary: Dict[str, float], phase: str):
@@ -190,6 +199,27 @@ class ExperimentBuilder:
         self.step_timer.tick()
         self._steps_this_run += 1
 
+    def train_iterations(self, train_samples, epoch_idx):
+        """Chunked variant: len(train_samples) updates in ONE device
+        dispatch (``steps_per_dispatch``). Per-iteration metrics are still
+        accumulated individually; the step timer ticks once per dispatch
+        (its percentiles then measure dispatch latency, k iterations
+        each)."""
+        if len(train_samples) == 1:
+            self.train_iteration(train_samples[0], epoch_idx)
+            return
+        self._maybe_profile_step()
+        losses = self.model.run_train_iters(
+            [(s[0], s[1], s[2], s[3]) for s in train_samples], epoch=epoch_idx
+        )
+        # ONE accumulation per chunk: device metrics arrive (k,)-stacked and
+        # the epoch summary flattens them — per-iteration slicing here would
+        # issue 2k tiny device programs per chunk (see run_train_iters)
+        self._accumulate(losses, self.total_losses)
+        self.state["current_iter"] += len(train_samples)
+        self.step_timer.tick()
+        self._steps_this_run += len(train_samples)
+
     def _maybe_profile_step(self):
         """Capture a jax profiler trace of train iterations
         [1, 1 + profile_num_steps) of this run when ``profile_trace_dir`` is
@@ -199,7 +229,13 @@ class ExperimentBuilder:
             return
         import jax
 
-        if not self._tracing and self._steps_this_run == 1:
+        if (
+            not self._tracing
+            and not self._profile_done
+            and self._steps_this_run >= 1
+        ):
+            # ">= 1", not "== 1": chunked dispatch (steps_per_dispatch > 1)
+            # advances the step counter by k, so exact equality never fires
             jax.profiler.start_trace(cfg.profile_trace_dir)
             self._tracing = True
         elif self._tracing and self._steps_this_run >= 1 + cfg.profile_num_steps:
@@ -208,6 +244,7 @@ class ExperimentBuilder:
             jax.block_until_ready(self.model.state.net)
             jax.profiler.stop_trace()
             self._tracing = False
+            self._profile_done = True
 
     def evaluation_iteration(self, val_sample, total_losses):
         x_s, x_t, y_s, y_t = val_sample[:4]
@@ -296,14 +333,30 @@ class ExperimentBuilder:
                 - self.state["current_iter"] % cfg.total_iter_per_epoch,
                 f"train epoch {self.epoch}",
             )
+            # chunked dispatch: accumulate steps_per_dispatch samples and
+            # flush them as one device program; always flush at the epoch
+            # boundary so a chunk never spans an epoch (LR/MSL/order are
+            # epoch-functions)
+            dispatch_k = max(1, int(cfg.steps_per_dispatch))
+            pending: List = []
             for train_sample in self.data.get_train_batches(
                 total_batches=remaining, augment_images=self.augment_flag
             ):
+                pending.append(train_sample)
+                at_boundary = (
+                    self.state["current_iter"] + len(pending)
+                ) % cfg.total_iter_per_epoch == 0
+                if len(pending) < dispatch_k and not at_boundary:
+                    continue
                 epoch_idx = self.state["current_iter"] / cfg.total_iter_per_epoch
-                self.train_iteration(train_sample, epoch_idx)
+                n_flushed = len(pending)
+                self.train_iterations(pending, epoch_idx)
+                pending = []
                 if self._active_pbar is not None:
                     # interactive: pay the device sync for live numbers;
                     # batch runs stay fully pipelined (no per-step sync)
+                    if n_flushed > 1:
+                        self._active_pbar.update(n_flushed - 1)
                     self._pbar_tick(
                         self._active_pbar,
                         self._running_summary(
@@ -370,6 +423,14 @@ class ExperimentBuilder:
                         self._active_pbar = self._pbar(
                             cfg.total_iter_per_epoch, f"train epoch {self.epoch}"
                         )
+            if pending:
+                # safety net: the loader always ends at an epoch boundary,
+                # but a truncated stream must not drop trained-sample work
+                self.train_iterations(
+                    pending,
+                    self.state["current_iter"] / cfg.total_iter_per_epoch,
+                )
+                pending = []
             self._close_pbar()
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
 

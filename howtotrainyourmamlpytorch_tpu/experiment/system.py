@@ -122,6 +122,7 @@ class MAMLFewShotClassifier:
                 flush=True,
             )
         self._train_steps: Dict[bool, Any] = {}
+        self._train_multi_steps: Dict[Any, Any] = {}
         self._eval_step = jax.jit(maml.make_eval_step(cfg))
         # 1-step-lag sync handle: bounds device run-ahead to one in-flight
         # step (backpressure against queued-input OOM) while still
@@ -138,13 +139,28 @@ class MAMLFewShotClassifier:
             )
         return self._train_steps[second_order]
 
-    def _prepare_batch(self, data_batch):
+    def _train_multi_step(self, second_order: bool, k: int):
+        key = (second_order, k)
+        if key not in self._train_multi_steps:
+            self._train_multi_steps[key] = jax.jit(
+                maml.make_train_multi_step(self.cfg, second_order),
+                donate_argnums=(0,),
+            )
+        return self._train_multi_steps[key]
+
+    def _convert_batch(self, data_batch):
+        """Layout/dtype conversion only (no device placement):
+        (x_s, y_s, x_t, y_t) as host numpy arrays."""
         x_s, x_t, y_s, y_t = data_batch[:4]
         layout, shape = self.cfg.input_layout, self.cfg.im_shape
         x_s = _to_nhwc(np.asarray(x_s, np.float32), layout, shape)
         x_t = _to_nhwc(np.asarray(x_t, np.float32), layout, shape)
         y_s = np.asarray(y_s, np.int32)
         y_t = np.asarray(y_t, np.int32)
+        return x_s, y_s, x_t, y_t
+
+    def _prepare_batch(self, data_batch):
+        x_s, y_s, x_t, y_t = self._convert_batch(data_batch)
         if self.multihost:
             # each host holds its slice of the global task axis; assemble the
             # global sharded arrays without any cross-host copy
@@ -169,14 +185,11 @@ class MAMLFewShotClassifier:
 
     # -- public API (reference-shaped) ------------------------------------
 
-    def run_train_iter(self, data_batch, epoch) -> Dict[str, Any]:
-        """One outer-loop update (ref :338-369). Returns the losses dict with
-        the reference's keys (loss, accuracy, loss_importance_vector_i,
-        learning_rate). loss/accuracy are DEVICE arrays (convert at summary
-        time — per-step float() would serialize the pipeline); the schedule
-        entries are host floats."""
-        epoch = int(epoch)
-        self.current_epoch = epoch
+    def _epoch_schedule(self, epoch: int):
+        """Everything the outer step needs that is a pure function of the
+        epoch: (lr, msl_weights, second_order, per-step anneal log values).
+        The single definition shared by the per-iteration and chunked
+        dispatch paths so their math can never diverge."""
         cfg = self.cfg
         lr = maml.cosine_lr(cfg, epoch)
         weights = msl.loss_weights_for(
@@ -189,6 +202,22 @@ class MAMLFewShotClassifier:
         second_order = bool(
             cfg.second_order and epoch > cfg.first_order_to_second_order_epoch
         )
+        anneal = msl.per_step_loss_importance(
+            cfg.number_of_training_steps_per_iter,
+            cfg.multi_step_loss_num_epochs,
+            epoch,
+        )
+        return lr, weights, second_order, anneal
+
+    def run_train_iter(self, data_batch, epoch) -> Dict[str, Any]:
+        """One outer-loop update (ref :338-369). Returns the losses dict with
+        the reference's keys (loss, accuracy, loss_importance_vector_i,
+        learning_rate). loss/accuracy are DEVICE arrays (convert at summary
+        time — per-step float() would serialize the pipeline); the schedule
+        entries are host floats."""
+        epoch = int(epoch)
+        self.current_epoch = epoch
+        lr, weights, second_order, anneal = self._epoch_schedule(epoch)
         x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
         # wait for the PREVIOUS step before enqueuing the next: a one-step
         # pipeline. (Zero sync would let the host run an epoch ahead, pinning
@@ -205,14 +234,58 @@ class MAMLFewShotClassifier:
         # forced per-step sync would be a round-trip
         losses = dict(metrics)
         # per-step MSL weights logged each iteration (ref :260-262)
-        anneal = msl.per_step_loss_importance(
-            cfg.number_of_training_steps_per_iter,
-            cfg.multi_step_loss_num_epochs,
-            epoch,
-        )
         for i, w in enumerate(anneal):
             losses[f"loss_importance_vector_{i}"] = float(w)
         losses["learning_rate"] = float(lr)  # ref :365
+        return losses
+
+    def run_train_iters(self, data_batches, epoch) -> Dict[str, Any]:
+        """len(data_batches) outer updates in ONE device dispatch
+        (``steps_per_dispatch``) — identical math to calling
+        ``run_train_iter`` that many times at the same epoch (LR, MSL
+        weights and the order flag are epoch-functions; the builder flushes
+        chunks at epoch boundaries so a chunk never spans one).
+
+        Returns ONE losses dict whose device metrics are (k,)-stacked —
+        NOT sliced per iteration: slicing would enqueue 2k tiny gather
+        programs per chunk and re-introduce the per-item dispatches this
+        path exists to amortize. The builder's epoch summary flattens the
+        stacks (one device fetch per chunk per key).
+
+        Multi-host runs fall back to per-iteration dispatch: their batch
+        assembly builds global sharded arrays per iteration and the
+        per-dispatch overhead this path amortizes is a single-host tunnel
+        artifact anyway.
+        """
+        if self.multihost or len(data_batches) == 1:
+            # merge the per-iter dicts into the same stacked-value contract
+            per_iter = [self.run_train_iter(b, epoch) for b in data_batches]
+            return {
+                key: (
+                    per_iter[0][key]
+                    if np.isscalar(per_iter[0][key])
+                    else [d[key] for d in per_iter]
+                )
+                for key in per_iter[0]
+            }
+        epoch = int(epoch)
+        self.current_epoch = epoch
+        lr, weights, second_order, anneal = self._epoch_schedule(epoch)
+        prepared = [self._convert_batch(b) for b in data_batches]
+        k = len(prepared)
+        stacked = tuple(np.stack(parts) for parts in zip(*prepared))
+        if self.mesh is not None:
+            stacked = mesh_lib.shard_stacked_batch(self.mesh, *stacked)
+        if self._pending_sync is not None:
+            jax.block_until_ready(self._pending_sync)
+        self.state, metrics = self._train_multi_step(second_order, k)(
+            self.state, *stacked, weights, lr
+        )
+        self._pending_sync = metrics["loss"]
+        losses: Dict[str, Any] = dict(metrics)  # values are (k,) device arrays
+        for j, w in enumerate(anneal):
+            losses[f"loss_importance_vector_{j}"] = float(w)
+        losses["learning_rate"] = float(lr)
         return losses
 
     def run_validation_iter(

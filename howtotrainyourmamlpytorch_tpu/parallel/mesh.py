@@ -43,22 +43,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _shard_on_axis(mesh: Mesh, arrays, axis: int, sharding: NamedSharding):
+    n = len(mesh.devices)
+    out = []
+    for a in arrays:
+        if a.shape[axis] % n != 0:
+            raise ValueError(
+                f"meta-batch {a.shape[axis]} not divisible by mesh size {n}"
+            )
+        out.append(jax.device_put(a, sharding))
+    return tuple(out)
+
+
+def shard_stacked_batch(mesh: Mesh, *arrays):
+    """Place (k, tasks, ...) stacked batches with the TASK axis (axis 1)
+    split over the mesh — the multi-dispatch (steps_per_dispatch) variant of
+    ``shard_batch``; the leading axis is the scan-over-steps axis and stays
+    replicated."""
+    return _shard_on_axis(
+        mesh, arrays, 1, NamedSharding(mesh, P(None, TASK_AXIS))
+    )
+
+
 def shard_batch(mesh: Mesh, *arrays):
     """Place batch arrays with the task axis split over the mesh.
 
     The task count must divide the mesh size — the reference had the same
     constraint implicitly (DataParallel scatters batch over GPUs).
     """
-    sharding = batch_sharding(mesh)
-    n = len(mesh.devices)
-    out = []
-    for a in arrays:
-        if a.shape[0] % n != 0:
-            raise ValueError(
-                f"meta-batch {a.shape[0]} not divisible by mesh size {n}"
-            )
-        out.append(jax.device_put(a, sharding))
-    return tuple(out)
+    return _shard_on_axis(mesh, arrays, 0, batch_sharding(mesh))
 
 
 def replicate_state(mesh: Mesh, tree):

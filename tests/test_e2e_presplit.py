@@ -51,6 +51,7 @@ def test_presplit_rgb_end_to_end(tmp_path):
         total_epochs_before_pause=100,
         num_dataprovider_workers=2, cache_dir=str(tmp_path / "cache"),
         use_mmap_cache=True, use_remat=False, seed=0,
+        steps_per_dispatch=2,  # exercise the chunked-dispatch builder path
     )
     assert cfg.clip_grads  # imagenet datasets clamp outer grads to ±10
     model = MAMLFewShotClassifier(cfg, use_mesh=False)

@@ -79,6 +79,36 @@ def test_to_nhwc_accepts_both_layouts(tiny_cfg):
         _to_nhwc(np.zeros((2, 3, 5, 7, 9), np.float32))
 
 
+def test_run_train_iters_matches_sequential(tiny_cfg):
+    """K updates in one dispatch (steps_per_dispatch / lax.scan) must match
+    K sequential single dispatches: same final params, same per-iteration
+    metrics."""
+    batches = [_batch(tiny_cfg, seed=s) for s in range(3)]
+    m_seq = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    seq_losses = [m_seq.run_train_iter(b, epoch=0) for b in batches]
+    m_chk = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    chk = m_chk.run_train_iters(batches, epoch=0)
+    # device metrics come back (k,)-stacked; schedule entries are scalars
+    chk_loss = np.asarray(chk["loss"])
+    chk_acc = np.asarray(chk["accuracy"])
+    assert chk_loss.shape == (3,) and chk_acc.shape == (3,)
+    for i, ls in enumerate(seq_losses):
+        np.testing.assert_allclose(
+            float(ls["loss"]), float(chk_loss[i]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(ls["accuracy"]), float(chk_acc[i]), rtol=1e-6
+        )
+        assert ls["learning_rate"] == chk["learning_rate"]
+    for k in m_seq.state.net:
+        np.testing.assert_allclose(
+            np.asarray(m_seq.state.net[k]),
+            np.asarray(m_chk.state.net[k]),
+            atol=1e-6,
+            err_msg=k,
+        )
+
+
 def test_to_nhwc_explicit_layout_never_guesses():
     # a 3xHxW image whose W == 3: the heuristic alone is ambiguous
     ambiguous = np.zeros((2, 4, 3, 5, 3), np.float32)
