@@ -1,0 +1,34 @@
+#!/bin/bash
+set -u
+cd /root/repo
+DATASET_DIR=/root/reference timeout 3600 python train_maml_system.py \
+  --experiment_name .round5/experiments/omniglot_5way_64f \
+  --dataset_name omniglot_dataset --dataset_path datasets/omniglot_dataset \
+  --train_val_test_split "[0.70918052988, 0.03080714725, 0.2606284658]" \
+  --num_classes_per_set 5 --num_samples_per_class 1 --num_target_samples 1 \
+  --batch_size 8 --cnn_num_filters 64 --num_stages 4 --max_pooling true \
+  --per_step_bn_statistics true \
+  --learnable_per_layer_per_step_inner_loop_learning_rate true \
+  --use_multi_step_loss_optimization true --second_order true \
+  --number_of_training_steps_per_iter 5 --number_of_evaluation_steps_per_iter 5 \
+  --total_epochs 500 --total_iter_per_epoch 100 --multi_step_loss_num_epochs 50 \
+  --num_evaluation_tasks 40 --total_epochs_before_pause 250 \
+  --steps_per_dispatch 20 \
+  --use_mmap_cache true --compilation_cache_dir .round5/xla_cache --seed 0 \
+  >> .round5/train5way_tpu.log 2>&1
+echo "extension rc=$?"
+DATASET_DIR=/root/reference timeout 3600 python train_maml_system.py \
+  --experiment_name .round5/experiments/omniglot_5way_64f \
+  --dataset_name omniglot_dataset --dataset_path datasets/omniglot_dataset \
+  --train_val_test_split "[0.70918052988, 0.03080714725, 0.2606284658]" \
+  --num_classes_per_set 5 --num_samples_per_class 1 --num_target_samples 1 \
+  --batch_size 8 --cnn_num_filters 64 --num_stages 4 --max_pooling true \
+  --per_step_bn_statistics true \
+  --learnable_per_layer_per_step_inner_loop_learning_rate true \
+  --use_multi_step_loss_optimization true --second_order true \
+  --number_of_training_steps_per_iter 5 --number_of_evaluation_steps_per_iter 5 \
+  --total_epochs 500 --total_iter_per_epoch 100 --multi_step_loss_num_epochs 50 \
+  --num_evaluation_tasks 600 --evaluate_on_test_set_only true \
+  --use_mmap_cache true --compilation_cache_dir .round5/xla_cache --seed 0 \
+  > .round5/ensemble_5way_final2.log 2>&1
+echo "ensemble2 rc=$? : $(tail -1 .round5/ensemble_5way_final2.log | cut -c1-100)"
