@@ -6,9 +6,13 @@ train step (Mini-ImageNet 5-way 5-shot shapes, 48-filter 4-stage backbone,
 data, so it isolates device compute from input-pipeline effects.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
-informational extras: mfu, backend, n_chips).  The reference publishes no
+informational extras: mfu, backend, n_chips, and the epoch_boundary block —
+fused-validation + checkpoint wall seconds, the serial tail the fused eval
+dispatch and async checkpointing amortize).  The reference publishes no
 throughput numbers (BASELINE.md), so ``vs_baseline`` is measured against our
-own recorded baseline when present (BENCH_BASELINE.json), else 1.0.
+own recorded baseline when present and knob-comparable
+(BENCH_BASELINE.json); with no comparable baseline it is null — never 1.0,
+which trend tooling would misread as "no change".
 
 Backend selection is defensive: the requested backend is first initialized
 in a *subprocess with a timeout*, because a stalled TPU tunnel hangs (or
@@ -243,11 +247,83 @@ def _devices_watchdogged():
     return result[0]
 
 
+def _time_epoch_boundary(cfg, state, batch, reduced: bool) -> dict:
+    """Wall-clock the epoch boundary: the fused validation sweep plus one
+    (async) checkpoint write — the serial tail that caps end-to-end epoch
+    time once the train path is fused (``steps_per_dispatch``).
+
+    val_seconds: BENCH_VAL_BATCHES eval batches dispatched in
+    ``eval_batches_per_dispatch``-sized fused chunks (compile excluded).
+    ckpt_seconds: one full epoch save (epoch-N write + host-side ``latest``
+    clone) from save-start to the durability barrier; ckpt_blocking_seconds
+    is the device->host copy alone — the part the train loop actually waits
+    on, the rest overlaps the next epoch's training.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    val_batches = int(
+        os.environ.get("BENCH_VAL_BATCHES", "2" if reduced else "8")
+    )
+    ebpd = int(
+        os.environ.get(
+            "BENCH_EVAL_BATCHES_PER_DISPATCH", "2" if reduced else "4"
+        )
+    )
+    ebpd = max(1, min(ebpd, val_batches))
+    n_dispatches = max(1, val_batches // ebpd)
+    host = [np.asarray(a) for a in batch]
+    stacked = tuple(np.stack([a] * ebpd) for a in host)
+    sharding = getattr(batch[0], "sharding", None)
+    if sharding is not None and getattr(sharding, "mesh", None) is not None:
+        # same placement the real eval driver uses (incl. divisibility check)
+        from howtotrainyourmamlpytorch_tpu.parallel import mesh as mesh_lib
+
+        stacked = mesh_lib.shard_stacked_batch(sharding.mesh, *stacked)
+    else:
+        stacked = jax.device_put(stacked)
+    eval_multi = jax.jit(maml.make_eval_multi_step(cfg, with_preds=False))
+    metrics, _ = eval_multi(state, *stacked)  # compile + warmup
+    jax.block_until_ready(metrics["loss"])
+    start = time.perf_counter()
+    for _ in range(n_dispatches):
+        metrics, _ = eval_multi(state, *stacked)
+    float(np.asarray(metrics["loss"])[-1])  # tunnel-proof sync (see sync())
+    val_seconds = time.perf_counter() - start
+
+    tmp_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        start = time.perf_counter()
+        ckpt.save_checkpoint_async(
+            tmp_dir, "train_model", 1, state,
+            {"current_iter": 0}, clone_to="latest",
+        )
+        blocking = time.perf_counter() - start
+        ckpt.wait_for_pending()
+        ckpt_seconds = time.perf_counter() - start
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    return {
+        "seconds": round(val_seconds + ckpt_seconds, 4),
+        "val_seconds": round(val_seconds, 4),
+        "ckpt_seconds": round(ckpt_seconds, 4),
+        "ckpt_blocking_seconds": round(blocking, 4),
+        "val_batches": n_dispatches * ebpd,
+        "eval_batches_per_dispatch": ebpd,
+    }
+
+
 # BENCH_* env vars that change WHAT is measured (workload shapes or
 # lowering); a run with any of these set must never refresh the baseline
 _WORKLOAD_KNOBS = (
     "BENCH_BATCH_SIZE", "BENCH_CNN_NUM_FILTERS", "BENCH_IMAGE_HEIGHT",
     "BENCH_IMAGE_WIDTH", "BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER",
+    "BENCH_NUMBER_OF_EVALUATION_STEPS_PER_ITER",
     "BENCH_COMPUTE_DTYPE", "BENCH_USE_REMAT", "BENCH_REMAT_POLICY",
     "BENCH_CONV_IMPL", "BENCH_POOL_IMPL", "BENCH_TASK_AXIS_MODE",
 )
@@ -278,7 +354,8 @@ def main() -> None:
     from howtotrainyourmamlpytorch_tpu.core import maml, msl
     overrides = {}
     for key in ("batch_size", "cnn_num_filters", "image_height", "image_width",
-                "number_of_training_steps_per_iter"):
+                "number_of_training_steps_per_iter",
+                "number_of_evaluation_steps_per_iter"):
         if f"BENCH_{key.upper()}" in os.environ:
             overrides[key] = int(os.environ[f"BENCH_{key.upper()}"])
     if "BENCH_COMPUTE_DTYPE" in os.environ:
@@ -386,6 +463,13 @@ def main() -> None:
     # convention
     tasks_per_sec = timed_steps * b / elapsed / (n_chips if sharded else 1)
 
+    # null when skipped (sweep points rank train throughput only)
+    epoch_boundary = None
+    if os.environ.get("BENCH_SKIP_EPOCH_BOUNDARY") != "1":
+        epoch_boundary = _time_epoch_boundary(
+            cfg, state, (x_s, y_s, x_t, y_t), reduced
+        )
+
     peak = _peak_flops(device_kind, cfg.compute_dtype)
     # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
     # recompute) over peak. hfu: *executed* FLOPs per XLA's cost analysis of
@@ -420,7 +504,9 @@ def main() -> None:
         "metric": "meta_tasks_per_sec_per_chip",
         "value": round(tasks_per_sec, 3),
         "unit": "tasks/s/chip",
-        "vs_baseline": 1.0,  # filled in below once comparability is known
+        # null = no comparable baseline (none stored, or stale knobs) —
+        # distinct from 1.0 = "no change"; replaced below when comparable
+        "vs_baseline": None,
         "mfu": mfu,
         "hfu": hfu,
         "xla_flops_per_task": (
@@ -438,6 +524,9 @@ def main() -> None:
         "remat_policy": cfg.remat_policy if cfg.use_remat else None,
         "matmul_precision": cfg.resolved_matmul_precision,
         "reduced": reduced,
+        # the serial tail between epochs: fused-val + checkpoint seconds
+        # (informational — not part of baseline comparability)
+        "epoch_boundary": epoch_boundary,
         # pinned workload descriptor: makes round-over-round lines
         # self-describing so a knob-default change can never silently turn
         # the driver series into an apples-to-oranges trend
@@ -492,7 +581,7 @@ def main() -> None:
         baseline_out = {
             k: v for k, v in result.items()
             if k not in ("vs_baseline", "baseline_backend",
-                         "baseline_refreshed")
+                         "baseline_refreshed", "epoch_boundary")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

@@ -160,6 +160,13 @@ class MAMLConfig:
     # the epoch (the builder flushes at epoch boundaries regardless);
     # single-host only (multi-host falls back to per-iter dispatch).
     steps_per_dispatch: int = 1
+    # eval twin of steps_per_dispatch: evaluation passes fused into ONE
+    # device dispatch (lax.scan over stacked eval batches). Amortizes the
+    # per-dispatch round-trip over the fixed 600-task validation epoch and
+    # the top-N test ensemble; metrics come back (k,)-stacked, preds
+    # (k, tasks, ...). Single-host only (multi-host falls back to per-iter
+    # dispatch, same as steps_per_dispatch).
+    eval_batches_per_dispatch: int = 1
     profile_trace_dir: str = ""  # jax profiler trace output ('' => disabled)
     profile_num_steps: int = 5  # train iterations captured in the trace
     # persistent XLA compilation cache: resumed runs skip the 20-40s TPU
@@ -224,6 +231,11 @@ class MAMLConfig:
         if self.steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
+            )
+        if self.eval_batches_per_dispatch < 1:
+            raise ValueError(
+                f"eval_batches_per_dispatch must be >= 1, got "
+                f"{self.eval_batches_per_dispatch}"
             )
         if self.matmul_precision not in ("auto", "default", "high", "highest"):
             raise ValueError(

@@ -76,6 +76,21 @@ class ExperimentBuilder:
             else:
                 self.create_summary_csv = True
         elif int(cont) >= 0:
+            if not checkpoint_exists(
+                self.saved_models_filepath, "train_model", int(cont)
+            ):
+                # max_models_to_save pruning keeps only the top-K epochs, so
+                # an explicit epoch resume can target a deleted checkpoint —
+                # name the cause instead of surfacing a raw orbax error
+                raise FileNotFoundError(
+                    f"checkpoint train_model_{int(cont)} not found in "
+                    f"{self.saved_models_filepath}; it was most likely "
+                    f"deleted by max_models_to_save="
+                    f"{cfg.max_models_to_save} pruning (only the top-K "
+                    "epochs by validation accuracy are kept). Resume with "
+                    "continue_from_epoch='latest' or from a surviving "
+                    "epoch checkpoint."
+                )
             self.state = self.model.load_model(self.saved_models_filepath, int(cont))
             self.start_epoch = int(
                 self.state["current_iter"] // cfg.total_iter_per_epoch
@@ -251,20 +266,46 @@ class ExperimentBuilder:
         losses, _ = self.model.run_validation_iter((x_s, x_t, y_s, y_t))
         self._accumulate(losses, total_losses)
 
+    def evaluation_iterations(self, val_samples, total_losses):
+        """Chunked variant: len(val_samples) eval passes in ONE device
+        dispatch (``eval_batches_per_dispatch``); metrics arrive
+        (k,)-stacked and the epoch summary flattens them — same contract
+        as ``train_iterations``."""
+        if len(val_samples) == 1:
+            self.evaluation_iteration(val_samples[0], total_losses)
+            return
+        losses, _ = self.model.run_validation_iters(
+            [(s[0], s[1], s[2], s[3]) for s in val_samples]
+        )
+        self._accumulate(losses, total_losses)
+
     def run_validation_epoch(self) -> Dict[str, float]:
         total_losses: Dict[str, List[float]] = {}
         pbar_sums: Dict[str, tuple] = {}
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
+        chunk_k = max(1, int(self.cfg.eval_batches_per_dispatch))
         pbar = self._pbar(n_batches, "val")
+        pending: List = []
         try:
             for val_sample in self.data.get_val_batches(total_batches=n_batches):
-                self.evaluation_iteration(val_sample, total_losses)
+                pending.append(val_sample)
+                if len(pending) < chunk_k:
+                    continue
+                n_flushed = len(pending)
+                self.evaluation_iterations(pending, total_losses)
+                pending = []
                 if pbar is not None:  # interactive: pay the sync for liveness
+                    if n_flushed > 1:
+                        pbar.update(n_flushed - 1)
                     self._pbar_tick(
                         pbar,
                         self._running_summary(pbar_sums, total_losses, "val"),
                         "val",
                     )
+            if pending:  # tail chunk when chunk_k doesn't divide n_batches
+                self.evaluation_iterations(pending, total_losses)
+                if pbar is not None:
+                    pbar.update(len(pending))
         finally:
             if pbar is not None:
                 pbar.close()
@@ -300,14 +341,23 @@ class ExperimentBuilder:
         try:
             return self._run_experiment()
         finally:
-            # the trace only materialises at stop — don't lose it when the
-            # run ends/pauses/raises before profile_num_steps completes
-            if self._tracing:
-                import jax
+            # flush the in-flight async checkpoint: the caller (and the
+            # controlled-pause sys.exit) must find every save on disk. A
+            # failed write re-raises here, but must not lose the trace below
+            from . import checkpoint as ckpt
 
-                jax.block_until_ready(self.model.state.net)
-                jax.profiler.stop_trace()
-                self._tracing = False
+            try:
+                ckpt.wait_for_pending()
+            finally:
+                # the trace only materialises at stop — don't lose it when
+                # the run ends/pauses/raises before profile_num_steps
+                # completes
+                if self._tracing:
+                    import jax
+
+                    jax.block_until_ready(self.model.state.net)
+                    jax.profiler.stop_trace()
+                    self._tracing = False
 
     def _close_pbar(self):
         if self._active_pbar is not None:
@@ -395,12 +445,13 @@ class ExperimentBuilder:
                     # re-trained epoch (cosmetic) instead of a permanently
                     # missing stat row (corrupting).
                     self.pack_and_save_metrics(train_losses, val_losses)
-                    # dual checkpoint: epoch-numbered + latest (:190-206)
+                    # dual checkpoint: epoch-numbered + latest (:190-206) —
+                    # ONE save whose host-side clone materialises `latest`
+                    # (one device->host serialization; the disk write
+                    # overlaps the next epoch's training, see checkpoint.py)
                     self.model.save_model(
-                        self.saved_models_filepath, int(self.epoch), self.state
-                    )
-                    self.model.save_model(
-                        self.saved_models_filepath, "latest", self.state
+                        self.saved_models_filepath, int(self.epoch),
+                        self.state, also_latest=True,
                     )
                     self._prune_saved_models()
                     self.total_losses = {}
@@ -451,6 +502,10 @@ class ExperimentBuilder:
             self.state["per_epoch_statistics"]["val_accuracy_mean"],
             dtype=float,
         )
+        if not self._stats_cover_on_disk_checkpoints(
+            len(val_acc), "skipping pruning"
+        ):
+            return
         # stat row i corresponds to checkpoint i+1 (1-based epoch counter at
         # save time — the ensemble's model_idx + 1 mapping). kind='stable' +
         # reverse = ties broken toward the LATER epoch, identically in every
@@ -469,6 +524,43 @@ class ExperimentBuilder:
                     self.saved_models_filepath, "train_model", epoch_idx
                 )
 
+    def _highest_epoch_checkpoint_index(self) -> int:
+        """Largest N with a finalized ``train_model_N`` directory on disk
+        (0 when none). In-flight ``.tmp`` writes don't count — they are not
+        loadable checkpoints yet."""
+        import re
+
+        highest = 0
+        try:
+            names = os.listdir(self.saved_models_filepath)
+        except OSError:
+            return 0
+        for name in names:
+            m = re.fullmatch(r"train_model_(\d+)", name)
+            if m and os.path.isdir(
+                os.path.join(self.saved_models_filepath, name)
+            ):
+                highest = max(highest, int(m.group(1)))
+        return highest
+
+    def _stats_cover_on_disk_checkpoints(self, n_rows: int, what: str) -> bool:
+        """Sanity-check the 'stat row i <-> checkpoint i+1' register before
+        acting on it: checkpoints written by code that saved BEFORE recording
+        metrics (the pre-reorder order) can sit one epoch ahead of
+        per_epoch_statistics after a crash+resume, and ranking such a history
+        would prune/ensemble the wrong epoch's checkpoint (ADVICE.md r5)."""
+        highest = self._highest_epoch_checkpoint_index()
+        if highest <= n_rows:
+            return True
+        self._log(
+            f"[builder] WARNING: {what}: on-disk epoch checkpoints reach "
+            f"train_model_{highest} but per_epoch_statistics has only "
+            f"{n_rows} val rows — the stat-row/checkpoint register is off "
+            "(history written by a pre-reorder run?); ranking it could "
+            "target the wrong epoch's checkpoint"
+        )
+        return False
+
     # -- final test ensemble (experiment_builder.py:247-300) --------------
 
     def evaluated_test_set_using_the_best_models(self, top_n_models: int = 5):
@@ -478,6 +570,9 @@ class ExperimentBuilder:
             top_n_models = min(top_n_models, int(self.cfg.max_models_to_save))
         per_epoch = self.state["per_epoch_statistics"]
         val_acc = np.copy(per_epoch["val_accuracy_mean"])
+        self._stats_cover_on_disk_checkpoints(
+            len(val_acc), "ensembling anyway"
+        )
         # kind='stable': must break ties exactly like _prune_saved_models
         # (see there) so a pruned run's surviving checkpoints are the ones
         # ranked here
@@ -520,27 +615,29 @@ class ExperimentBuilder:
     def _ensemble_predict(self, sorted_idx, n_batches):
         """Collect per-model softmax preds (and, once, the targets) over the
         test stream for each top checkpoint. Loads each checkpoint into
-        ``self.model`` (reference experiment_builder.py:262-276)."""
+        ``self.model`` (reference experiment_builder.py:262-276). Batches are
+        dispatched in ``eval_batches_per_dispatch`` chunks like the
+        validation epoch — the per-checkpoint test sweep is the other half
+        of the epoch-boundary dispatch tail."""
+        chunk_k = max(1, int(self.cfg.eval_batches_per_dispatch))
         per_model_preds: List[List[np.ndarray]] = [[] for _ in sorted_idx]
         all_targets: List[np.ndarray] = []
-        for idx, model_idx in enumerate(sorted_idx):
-            # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
-            # (experiment_builder.py:265): epoch counter is 1-based at save
-            self.state = self.model.load_model(
-                self.saved_models_filepath, int(model_idx) + 1
+
+        def flush(idx, samples):
+            _, preds = self.model.run_validation_iters(
+                [(s[0], s[1], s[2], s[3]) for s in samples],
+                return_preds=True,
             )
-            for test_sample in self.data.get_test_batches(total_batches=n_batches):
-                x_s, x_t, y_s, y_t = test_sample[:4]
-                _, preds = self.model.run_validation_iter(
-                    (x_s, x_t, y_s, y_t), return_preds=True
-                )
-                if self._active_pbar is not None:
-                    self._active_pbar.update(1)
-                per_model_preds[idx].extend(list(preds))
+            if self._active_pbar is not None:
+                self._active_pbar.update(len(samples))
+            # preds arrive (k, tasks, targets, classes): per-batch slices
+            # keep the sequential path's list-of-task-arrays accumulation
+            for j, sample in enumerate(samples):
+                per_model_preds[idx].extend(list(preds[j]))
                 if idx == 0:
                     # the test stream is identical per call (fixed seed), so
                     # targets only need gathering once, not once per model
-                    t = np.asarray(y_t)
+                    t = np.asarray(sample[3])
                     all_targets.extend(
                         list(
                             self.model.gather_across_hosts(
@@ -548,4 +645,20 @@ class ExperimentBuilder:
                             )
                         )
                     )
+
+        for idx, model_idx in enumerate(sorted_idx):
+            # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
+            # (experiment_builder.py:265): epoch counter is 1-based at save
+            self.state = self.model.load_model(
+                self.saved_models_filepath, int(model_idx) + 1
+            )
+            pending: List = []
+            for test_sample in self.data.get_test_batches(total_batches=n_batches):
+                pending.append(test_sample)
+                if len(pending) < chunk_k:
+                    continue
+                flush(idx, pending)
+                pending = []
+            if pending:
+                flush(idx, pending)
         return per_model_preds, all_targets

@@ -16,14 +16,34 @@ Preserves the reference's checkpoint contract (few_shot_learning_system.py:
 
 TPU-native: orbax writes the array pytree (async-capable, multi-host-safe),
 replacing ``torch.save`` of a state_dict.
+
+Single-host saves are ASYNC and DEDUPLICATED (``save_checkpoint_async``):
+``ocp.AsyncCheckpointer`` copies the pytree device->host synchronously (so
+the caller may immediately donate the state to the next train dispatch) and
+writes to ``<ckpt>.tmp`` in the background; a finalizer thread then swaps the
+tmp into place and, when requested, clones ``train_model_latest`` from the
+finished epoch directory HOST-side — one device->host serialization per
+epoch where the reference (and our previous sync path) paid two.  Crash
+safety: ``latest`` is only ever replaced from a fully-written epoch
+directory, so a kill anywhere between save-start and the barrier leaves the
+previous ``latest`` loadable.  ``wait_for_pending`` is the correctness
+barrier — called before every subsequent save/load/exists, before pruning
+the in-flight path, and at interpreter exit.
+
+Multi-process runs keep the synchronous collective path (``save_checkpoint``)
+with its cross-host barriers: the per-dispatch overhead the async path
+amortizes is a single-host tunnel artifact, and the primary-only swap logic
+would otherwise need a third barrier.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -32,6 +52,45 @@ import orbax.checkpoint as ocp
 from ..core.maml import MetaState
 
 _EXPERIMENT_STATE_FILE = "experiment_state.json"
+
+# one in-flight async save at a time: (finalizer thread, paths it will
+# create/replace, error holder). Module-level because checkpoints are a
+# process-wide filesystem resource, not per-system-object.
+_pending_save: Optional[Tuple[threading.Thread, Tuple[str, ...], List]] = None
+_async_checkpointer: Optional[ocp.AsyncCheckpointer] = None
+
+
+def _get_async_checkpointer() -> ocp.AsyncCheckpointer:
+    global _async_checkpointer
+    if _async_checkpointer is None:
+        _async_checkpointer = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler()
+        )
+    return _async_checkpointer
+
+
+def wait_for_pending(touching: Optional[str] = None) -> None:
+    """Barrier for the in-flight async save.
+
+    ``touching=None`` always waits; ``touching=<path>`` waits only when the
+    pending finalize will create or replace that path — pruning an unrelated
+    epoch directory can proceed concurrently with the background write.
+    Re-raises any exception the finalizer hit (a failed checkpoint write
+    must fail the run, not vanish into a daemon thread).
+    """
+    global _pending_save
+    if _pending_save is None:
+        return
+    thread, paths, errors = _pending_save
+    if touching is not None and touching not in paths:
+        return
+    thread.join()
+    _pending_save = None
+    if errors:
+        raise errors[0]
+
+
+atexit.register(wait_for_pending)
 
 
 def _ckpt_dir(model_save_dir: str, model_name: str, model_idx) -> str:
@@ -56,6 +115,7 @@ def save_checkpoint(
 ) -> str:
     """Write one checkpoint directory (ref: save_model,
     few_shot_learning_system.py:399-408)."""
+    wait_for_pending()  # serialize with any in-flight async save
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     tmp = path + ".tmp"
     multiprocess = jax.process_count() > 1
@@ -81,8 +141,7 @@ def save_checkpoint(
         # the same path from two processes would race
         with open(os.path.join(tmp, _EXPERIMENT_STATE_FILE), "w") as f:
             json.dump(experiment_state, f, cls=_NumpyEncoder)
-        shutil.rmtree(path, ignore_errors=True)
-        os.replace(tmp, path)
+        _swap_into_place(tmp, path)
     if multiprocess:
         from jax.experimental import multihost_utils
 
@@ -91,6 +150,106 @@ def save_checkpoint(
         multihost_utils.sync_global_devices(
             f"ckpt_swap_{model_name}_{model_idx}"
         )
+    return path
+
+
+def _swap_into_place(tmp: str, path: str) -> None:
+    """Crash-safe tmp -> final swap shared by the sync and async paths.
+
+    The previous directory is renamed aside (atomic) before the new one is
+    renamed in (atomic), then deleted — never rmtree'd while it is the only
+    copy. A kill between the two renames leaves ``<path>.old``, which
+    ``_recover_interrupted_swap`` restores on the next exists/load; so a
+    complete checkpoint is recoverable at every instant, closing the
+    rmtree-length window the old rmtree+replace sequence had.
+    """
+    old = path + ".old"
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.isdir(path):
+        os.replace(path, old)
+    os.replace(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def _recover_interrupted_swap(path: str) -> None:
+    """Finish a swap that was killed between its two renames: if ``path`` is
+    gone but ``<path>.old`` survives, the old checkpoint is still complete —
+    move it back."""
+    old = path + ".old"
+    if not os.path.isdir(path) and os.path.isdir(old):
+        try:
+            os.replace(old, path)
+        except OSError:
+            # lost the recovery race to another process on the shared
+            # filesystem — whoever won produced the same result
+            pass
+
+
+def save_checkpoint_async(
+    model_save_dir: str,
+    model_name: str,
+    model_idx,
+    state: MetaState,
+    experiment_state: Dict[str, Any],
+    clone_to=None,
+) -> str:
+    """Start an async checkpoint write; returns once the pytree is copied
+    device->host (safe to donate/mutate ``state`` afterwards).
+
+    The background finalizer waits for orbax's write, swaps ``.tmp`` into
+    ``<model_name>_<model_idx>``, then — when ``clone_to`` is given (the
+    builder passes ``"latest"``) — clones that finished directory host-side
+    into ``<model_name>_<clone_to>`` via its own tmp+swap.  The epoch-N
+    write and ``latest`` therefore share ONE device->host serialization, and
+    ``latest`` is only ever replaced from a complete on-disk checkpoint.
+
+    Single-host only: multi-process callers use the collective
+    ``save_checkpoint``.
+    """
+    global _pending_save
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            "save_checkpoint_async is single-host only; multi-process runs "
+            "use the collective save_checkpoint"
+        )
+    wait_for_pending()  # one in-flight save: serialize with the previous one
+    path = _ckpt_dir(model_save_dir, model_name, model_idx)
+    tmp = path + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    ckptr = _get_async_checkpointer()
+    # blocks only for the device->host copy; the disk write is backgrounded
+    ckptr.save(
+        os.path.join(tmp, "state"),
+        args=ocp.args.StandardSave(state._asdict()),
+    )
+    with open(os.path.join(tmp, _EXPERIMENT_STATE_FILE), "w") as f:
+        json.dump(experiment_state, f, cls=_NumpyEncoder)
+    clone_path = (
+        _ckpt_dir(model_save_dir, model_name, clone_to)
+        if clone_to is not None
+        else None
+    )
+    errors: List = []
+
+    def _finalize():
+        try:
+            ckptr.wait_until_finished()
+            _swap_into_place(tmp, path)
+            if clone_path is not None:
+                clone_tmp = clone_path + ".tmp"
+                shutil.rmtree(clone_tmp, ignore_errors=True)
+                shutil.copytree(path, clone_tmp)
+                _swap_into_place(clone_tmp, clone_path)
+        except BaseException as e:  # noqa: BLE001 - re-raised at the barrier
+            errors.append(e)
+
+    thread = threading.Thread(
+        target=_finalize, name="ckpt-finalize", daemon=True
+    )
+    thread.start()
+    touched = (path,) if clone_path is None else (path, clone_path)
+    _pending_save = (thread, touched, errors)
     return path
 
 
@@ -105,7 +264,9 @@ def load_checkpoint(
     :param target_state: a state of the right structure (e.g. from
         ``maml.init_state``) providing shapes/dtypes for orbax.
     """
+    wait_for_pending()  # never read past an in-flight async save
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
+    _recover_interrupted_swap(path)
     abstract = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
         if hasattr(x, "shape")
@@ -120,17 +281,29 @@ def load_checkpoint(
 
 
 def checkpoint_exists(model_save_dir: str, model_name: str, model_idx) -> bool:
-    return os.path.isdir(_ckpt_dir(model_save_dir, model_name, model_idx))
+    path = _ckpt_dir(model_save_dir, model_name, model_idx)
+    wait_for_pending(touching=path)
+    _recover_interrupted_swap(path)
+    return os.path.isdir(path)
 
 
 def remove_checkpoint(model_save_dir: str, model_name: str, model_idx) -> None:
     """Delete one checkpoint directory; missing is fine.
+
+    Waits for the in-flight async save only when IT targets this path —
+    otherwise a prune of the just-written epoch would race the background
+    finalize (rmtree of a not-yet-materialized dir, then the finalize
+    resurrecting it). Pruning unrelated epochs overlaps the write freely.
 
     Multi-host: only the primary touches the shared filesystem (no barrier
     needed — pruning is best-effort hygiene, never load-bearing).
     """
     if jax.process_count() > 1 and jax.process_index() != 0:
         return
-    shutil.rmtree(
-        _ckpt_dir(model_save_dir, model_name, model_idx), ignore_errors=True
-    )
+    path = _ckpt_dir(model_save_dir, model_name, model_idx)
+    wait_for_pending(touching=path)
+    shutil.rmtree(path, ignore_errors=True)
+    # also drop a crash-leftover swap sibling: were it to linger,
+    # _recover_interrupted_swap would resurrect the pruned checkpoint with
+    # pre-prune contents on the next exists/load probe
+    shutil.rmtree(path + ".old", ignore_errors=True)

@@ -124,6 +124,7 @@ class MAMLFewShotClassifier:
         self._train_steps: Dict[bool, Any] = {}
         self._train_multi_steps: Dict[Any, Any] = {}
         self._eval_step = jax.jit(maml.make_eval_step(cfg))
+        self._eval_multi_steps: Dict[bool, Any] = {}
         # 1-step-lag sync handle: bounds device run-ahead to one in-flight
         # step (backpressure against queued-input OOM) while still
         # overlapping host work with device compute
@@ -147,6 +148,13 @@ class MAMLFewShotClassifier:
                 donate_argnums=(0,),
             )
         return self._train_multi_steps[key]
+
+    def _eval_multi_step(self, with_preds: bool):
+        if with_preds not in self._eval_multi_steps:
+            self._eval_multi_steps[with_preds] = jax.jit(
+                maml.make_eval_multi_step(self.cfg, with_preds)
+            )
+        return self._eval_multi_steps[with_preds]
 
     def _convert_batch(self, data_batch):
         """Layout/dtype conversion only (no device placement):
@@ -178,10 +186,22 @@ class MAMLFewShotClassifier:
                 )
             return tuple(out)
         if self.mesh is not None:
-            x_s, y_s, x_t, y_t = mesh_lib.shard_batch(
-                self.mesh, x_s, y_s, x_t, y_t
-            )
-        return x_s, y_s, x_t, y_t
+            return mesh_lib.shard_batch(self.mesh, x_s, y_s, x_t, y_t)
+        # explicit async upload (device_put enqueues and returns): callers
+        # prepare the batch BEFORE blocking on _pending_sync, so the H2D
+        # transfer overlaps the still-running previous dispatch instead of
+        # serializing behind it at jit-call time (double-buffered uploads)
+        return jax.device_put((x_s, y_s, x_t, y_t))
+
+    def _upload_stacked(self, prepared):
+        """Stack per-iteration batches along a leading k axis and start the
+        (async) upload — sharded task axis on a mesh, plain device_put
+        otherwise. Called before the one-step-lag sync so the H2D transfer
+        overlaps the in-flight dispatch (see _prepare_batch)."""
+        stacked = tuple(np.stack(parts) for parts in zip(*prepared))
+        if self.mesh is not None:
+            return mesh_lib.shard_stacked_batch(self.mesh, *stacked)
+        return jax.device_put(stacked)
 
     # -- public API (reference-shaped) ------------------------------------
 
@@ -273,9 +293,9 @@ class MAMLFewShotClassifier:
         lr, weights, second_order, anneal = self._epoch_schedule(epoch)
         prepared = [self._convert_batch(b) for b in data_batches]
         k = len(prepared)
-        stacked = tuple(np.stack(parts) for parts in zip(*prepared))
-        if self.mesh is not None:
-            stacked = mesh_lib.shard_stacked_batch(self.mesh, *stacked)
+        stacked = self._upload_stacked(prepared)
+        # upload already enqueued above — blocking here only bounds run-ahead
+        # to one in-flight dispatch while this chunk's H2D streams in
         if self._pending_sync is not None:
             jax.block_until_ready(self._pending_sync)
         self.state, metrics = self._train_multi_step(second_order, k)(
@@ -315,6 +335,46 @@ class MAMLFewShotClassifier:
             out_preds = np.asarray(preds)
         return metrics, out_preds
 
+    def run_validation_iters(
+        self, data_batches, return_preds: bool = False
+    ) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
+        """len(data_batches) evaluation passes in ONE device dispatch
+        (``eval_batches_per_dispatch``) — identical math to calling
+        ``run_validation_iter`` once per batch.
+
+        Returns ONE (losses, preds) pair: device metrics come back
+        (k,)-stacked (the builder's epoch summary flattens them, same
+        contract as ``run_train_iters``); preds — only with
+        ``return_preds=True`` — as a host (k, tasks, targets, classes)
+        array the ensemble slices per batch.
+
+        Multi-host runs fall back to per-iteration dispatch (their batch
+        assembly is per-iteration and the preds allgather lives in
+        ``run_validation_iter``).
+        """
+        if self.multihost or len(data_batches) == 1:
+            per_iter = [
+                self.run_validation_iter(b, return_preds)
+                for b in data_batches
+            ]
+            losses = {
+                key: [m[key] for m, _ in per_iter] for key in per_iter[0][0]
+            }
+            preds = (
+                np.stack([p for _, p in per_iter]) if return_preds else None
+            )
+            return losses, preds
+        prepared = [self._convert_batch(b) for b in data_batches]
+        stacked = self._upload_stacked(prepared)
+        if self._pending_sync is not None:  # same one-step pipeline as train
+            jax.block_until_ready(self._pending_sync)
+        metrics, preds = self._eval_multi_step(return_preds)(
+            self.state, *stacked
+        )
+        self._pending_sync = metrics["loss"]
+        out_preds = np.asarray(preds) if return_preds else None
+        return dict(metrics), out_preds
+
     def gather_across_hosts(self, a: np.ndarray) -> np.ndarray:
         """Concatenate per-host arrays along axis 0 (identity single-host).
 
@@ -332,10 +392,31 @@ class MAMLFewShotClassifier:
     # -- checkpointing (ref :399-424) -------------------------------------
 
     def save_model(self, model_save_dir: str, model_idx,
-                   experiment_state: Dict[str, Any]) -> str:
-        return ckpt.save_checkpoint(
+                   experiment_state: Dict[str, Any],
+                   also_latest: bool = False) -> str:
+        """Checkpoint the current state as ``train_model_<model_idx>``.
+
+        ``also_latest=True`` additionally materialises ``train_model_latest``
+        from the same write — single-host via the async path's host-side
+        clone (ONE device->host serialization, disk write overlapping the
+        next epoch's training; the barrier lives in checkpoint.py), multi-host
+        via a second collective save (the async path is single-host only).
+        """
+        if self.multihost:
+            path = ckpt.save_checkpoint(
+                model_save_dir, "train_model", model_idx, self.state,
+                experiment_state,
+            )
+            if also_latest:
+                ckpt.save_checkpoint(
+                    model_save_dir, "train_model", "latest", self.state,
+                    experiment_state,
+                )
+            return path
+        return ckpt.save_checkpoint_async(
             model_save_dir, "train_model", model_idx, self.state,
             experiment_state,
+            clone_to="latest" if also_latest else None,
         )
 
     def load_model(self, model_save_dir: str, model_idx) -> Dict[str, Any]:

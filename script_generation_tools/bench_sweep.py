@@ -55,6 +55,10 @@ def main() -> None:
                 "BENCH_USE_REMAT": remat,
                 "BENCH_REMAT_POLICY": policy,
                 "BENCH_TIMED_STEPS": args.steps,
+                # sweeps rank TRAIN throughput; the epoch-boundary tail
+                # (eval compile + checkpoint write) would only slow every
+                # point without changing the ranking
+                "BENCH_SKIP_EPOCH_BOUNDARY": "1",
             }
             if args.batch:
                 ov["BENCH_BATCH_SIZE"] = args.batch

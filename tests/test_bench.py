@@ -30,6 +30,9 @@ def test_bench_prints_parsable_json_line():
         BENCH_IMAGE_HEIGHT="16",
         BENCH_IMAGE_WIDTH="16",
         BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER="2",
+        # keep the epoch-boundary eval compile cheap in CI (first-order,
+        # 2 inner steps); the measurement itself is still exercised
+        BENCH_NUMBER_OF_EVALUATION_STEPS_PER_ITER="2",
         BENCH_NO_BASELINE_WRITE="1",
     )
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -47,8 +50,17 @@ def test_bench_prints_parsable_json_line():
     assert rec["metric"] == "meta_tasks_per_sec_per_chip"
     assert rec["value"] > 0
     assert rec["unit"] == "tasks/s/chip"
-    assert rec["vs_baseline"] > 0
+    # stored baselines are TPU-recorded; this CPU run has no comparable
+    # baseline -> null, never a bogus 1.0 that reads as "no change"
+    assert rec["vs_baseline"] is None
     assert rec["backend"] == "cpu"
+    # the epoch-boundary tail (fused val + checkpoint) is measured and
+    # self-describing
+    eb = rec["epoch_boundary"]
+    assert eb["seconds"] > 0
+    assert eb["val_seconds"] > 0 and eb["ckpt_seconds"] > 0
+    assert eb["ckpt_seconds"] >= eb["ckpt_blocking_seconds"]
+    assert eb["val_batches"] >= 1 and eb["eval_batches_per_dispatch"] >= 1
     assert rec["n_chips"] >= 1
     assert rec["dtype"] in ("float32", "bfloat16")
     # CPU has no published MXU peak -> mfu is null, never a bogus number

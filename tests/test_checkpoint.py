@@ -4,6 +4,7 @@ few_shot_learning_system.py:399-424, experiment_builder.py:190-206)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from howtotrainyourmamlpytorch_tpu.core import maml
 from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
@@ -66,3 +67,147 @@ def test_overwrite_latest(tiny_cfg, tmp_path):
     r, e = ckpt.load_checkpoint(str(tmp_path), "train_model", "latest", maml.init_state(cfg))
     assert _tree_equal(r.net, s2.net)
     assert e["current_iter"] == 2
+
+
+class _CountingCheckpointer:
+    """Proxy that counts device->host serializations (``save`` calls)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.saves = 0
+
+    def save(self, *args, **kwargs):
+        self.saves += 1
+        return self.inner.save(*args, **kwargs)
+
+    def wait_until_finished(self):
+        return self.inner.wait_until_finished()
+
+
+def test_async_save_dedups_latest_single_serialization(
+    tiny_cfg, tmp_path, monkeypatch
+):
+    """One epoch save with clone_to='latest' must produce BOTH loadable
+    checkpoints from exactly ONE pytree serialization, and the experiment
+    state (incl. per_epoch_statistics) must round-trip through the async
+    path + barrier."""
+    cfg = tiny_cfg
+    state = maml.init_state(cfg, seed=3)
+    exp_state = {
+        "best_val_acc": 0.5,
+        "current_iter": 8,
+        "per_epoch_statistics": {"val_accuracy_mean": [0.25, 0.5]},
+    }
+    counting = _CountingCheckpointer(ckpt._get_async_checkpointer())
+    monkeypatch.setattr(ckpt, "_get_async_checkpointer", lambda: counting)
+    ckpt.save_checkpoint_async(
+        str(tmp_path), "train_model", 2, state, exp_state, clone_to="latest"
+    )
+    ckpt.wait_for_pending()
+    assert counting.saves == 1
+    for idx in (2, "latest"):
+        restored, exp_restored = ckpt.load_checkpoint(
+            str(tmp_path), "train_model", idx, maml.init_state(cfg)
+        )
+        assert _tree_equal(restored.net, state.net)
+        assert _tree_equal(restored.opt, state.opt)
+        assert exp_restored == exp_state
+
+
+def test_async_save_barriers_are_path_aware(tiny_cfg, tmp_path):
+    """checkpoint_exists/remove_checkpoint on the in-flight path must wait
+    for the finalize (no resurrection after a prune); a later sync save
+    serializes behind the pending async one."""
+    cfg = tiny_cfg
+    s1 = maml.init_state(cfg, seed=1)
+    ckpt.save_checkpoint_async(str(tmp_path), "train_model", 1, s1, {"current_iter": 1})
+    # exists() barriers on the touched path: the checkpoint must be visible
+    assert ckpt.checkpoint_exists(str(tmp_path), "train_model", 1)
+    # prune of the just-saved epoch: barrier first, then rmtree — the
+    # background finalize must never resurrect a pruned directory
+    ckpt.save_checkpoint_async(str(tmp_path), "train_model", 2, s1, {"current_iter": 2})
+    ckpt.remove_checkpoint(str(tmp_path), "train_model", 2)
+    ckpt.wait_for_pending()
+    assert not ckpt.checkpoint_exists(str(tmp_path), "train_model", 2)
+    assert ckpt.checkpoint_exists(str(tmp_path), "train_model", 1)
+
+
+_KILL_CHILD = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.core import maml
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+cfg = MAMLConfig(
+    image_height=8, image_width=8, image_channels=1,
+    num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+    batch_size=2, cnn_num_filters=4, num_stages=1,
+    number_of_training_steps_per_iter=1,
+    number_of_evaluation_steps_per_iter=1, use_remat=False,
+)
+save_dir = {save_dir!r}
+s1 = maml.init_state(cfg, seed=1)
+ckpt.save_checkpoint(save_dir, "train_model", "latest", s1, {{"current_iter": 1}})
+s2 = maml.init_state(cfg, seed=2)
+# async epoch-2 save that would re-clone `latest`; the parent SIGKILLs us
+# between save-start and the barrier
+ckpt.save_checkpoint_async(
+    save_dir, "train_model", 2, s2, {{"current_iter": 2}}, clone_to="latest"
+)
+print("SAVE_STARTED", flush=True)
+time.sleep(120)  # killed here; never reaches wait_for_pending
+"""
+
+
+@pytest.mark.slow
+def test_kill_between_async_save_start_and_barrier_keeps_latest_loadable(
+    tiny_cfg, tmp_path,
+):
+    """SIGKILL a process after save_checkpoint_async returns but before the
+    barrier: `latest` must still load — either the pre-save state (kill beat
+    the background finalize) or the new one (finalize beat the kill), never
+    a corrupt directory."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    save_dir = str(tmp_path / "ckpts")
+    os.makedirs(save_dir)
+    code = _KILL_CHILD.format(repo=repo, save_dir=save_dir)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "SAVE_STARTED" in line, proc.stderr.read()
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # the child's tiny config, mirrored for restore shapes
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+    cfg = MAMLConfig(
+        image_height=8, image_width=8, image_channels=1,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+        batch_size=2, cnn_num_filters=4, num_stages=1,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1, use_remat=False,
+    )
+    assert ckpt.checkpoint_exists(save_dir, "train_model", "latest")
+    restored, exp = ckpt.load_checkpoint(
+        save_dir, "train_model", "latest", maml.init_state(cfg)
+    )
+    assert exp["current_iter"] in (1, 2)
+    expected = maml.init_state(cfg, seed=exp["current_iter"])
+    assert _tree_equal(restored.net, expected.net)

@@ -52,6 +52,9 @@ def test_presplit_rgb_end_to_end(tmp_path):
         num_dataprovider_workers=2, cache_dir=str(tmp_path / "cache"),
         use_mmap_cache=True, use_remat=False, seed=0,
         steps_per_dispatch=2,  # exercise the chunked-dispatch builder path
+        # fused eval: 4 tasks / batch 2 = 2 val batches -> ONE dispatch per
+        # validation epoch, and the test ensemble sweeps in fused chunks
+        eval_batches_per_dispatch=2,
     )
     assert cfg.clip_grads  # imagenet datasets clamp outer grads to ±10
     model = MAMLFewShotClassifier(cfg, use_mesh=False)
@@ -150,3 +153,22 @@ def test_max_models_to_save_prunes_checkpoints(tmp_path):
         for i in np.argsort(val, kind="stable")[::-1][:2]
     }
     assert epoch_ckpts == expected
+
+    # resuming from a pruned epoch raises a clear error naming pruning as
+    # the cause, not a raw orbax FileNotFoundError (ADVICE.md r5)
+    pruned = {1, 2, 3, 4} - {int(n.rsplit("_", 1)[1]) for n in epoch_ckpts}
+    cfg_resume = cfg.replace(continue_from_epoch=str(min(pruned)))
+    model_resume = MAMLFewShotClassifier(cfg_resume, use_mesh=False)
+    with pytest.raises(FileNotFoundError, match="max_models_to_save"):
+        ExperimentBuilder(
+            cfg_resume, model_resume, MetaLearningDataLoader,
+            experiment_root=str(tmp_path), verbose=False,
+        )
+
+    # a stats/checkpoint register mismatch (on-disk epoch checkpoint beyond
+    # the recorded val rows, i.e. pre-reorder history) disables pruning
+    # instead of ranking — and possibly deleting — off-register checkpoints
+    os.makedirs(os.path.join(builder.saved_models_filepath, "train_model_99"))
+    before = set(os.listdir(builder.saved_models_filepath))
+    builder._prune_saved_models()
+    assert set(os.listdir(builder.saved_models_filepath)) == before

@@ -109,6 +109,45 @@ def test_run_train_iters_matches_sequential(tiny_cfg):
         )
 
 
+def test_run_validation_iters_matches_sequential(tiny_cfg):
+    """K eval passes in one dispatch (eval_batches_per_dispatch / lax.scan)
+    must match K sequential run_validation_iter dispatches batch-for-batch:
+    same per-batch metrics, same ensemble predictions."""
+    batches = [_batch(tiny_cfg, seed=s) for s in range(3)]
+    model = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    # eval never mutates state, so one model serves both paths
+    seq = [model.run_validation_iter(b, return_preds=True) for b in batches]
+    losses, preds = model.run_validation_iters(batches, return_preds=True)
+    chk_loss = np.asarray(losses["loss"])
+    chk_acc = np.asarray(losses["accuracy"])
+    assert chk_loss.shape == (3,) and chk_acc.shape == (3,)
+    b = tiny_cfg.batch_size
+    n, t = tiny_cfg.num_classes_per_set, tiny_cfg.num_target_samples
+    assert preds.shape == (3, b, n * t, n)
+    for i, (seq_metrics, seq_preds) in enumerate(seq):
+        np.testing.assert_allclose(
+            float(seq_metrics["loss"]), float(chk_loss[i]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(seq_metrics["accuracy"]), float(chk_acc[i]), rtol=1e-6
+        )
+        np.testing.assert_allclose(seq_preds, preds[i], atol=1e-6)
+    # plain validation: no preds materialised
+    losses_np, preds_np = model.run_validation_iters(batches)
+    assert preds_np is None
+    np.testing.assert_allclose(
+        np.asarray(losses_np["loss"]), chk_loss, rtol=1e-6
+    )
+    # k=1 falls back to the sequential path with the same stacked contract
+    losses_1, preds_1 = model.run_validation_iters(
+        batches[:1], return_preds=True
+    )
+    np.testing.assert_allclose(
+        float(np.asarray(losses_1["loss"][0])), float(chk_loss[0]), rtol=1e-5
+    )
+    assert preds_1.shape == (1, b, n * t, n)
+
+
 def test_to_nhwc_explicit_layout_never_guesses():
     # a 3xHxW image whose W == 3: the heuristic alone is ambiguous
     ambiguous = np.zeros((2, 4, 3, 5, 3), np.float32)
