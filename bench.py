@@ -318,6 +318,94 @@ def _time_epoch_boundary(cfg, state, batch, reduced: bool) -> dict:
     }
 
 
+def _measure_input_pipeline(cfg, reduced: bool) -> dict | None:
+    """Three-tier input-pipeline measurement (ISSUE 2): per placement tier
+    (host float32 / uint8_stream / device index-only), the H2D payload bytes
+    per step, host episode-assembly ms per step, and producer-queue stall ms
+    per step, on a small synthetic on-disk dataset with the benchmark's
+    image shape and task geometry.
+
+    The payload is measured from the loader's actually-emitted arrays (not
+    modeled), so the uint8 4x and index-only <<1 MB claims are checked
+    against real batches. Informational like ``epoch_boundary`` — never part
+    of baseline comparability. Best-effort: any failure returns None with a
+    note on stderr rather than killing the bench line.
+    """
+    import shutil
+    import tempfile
+
+    try:
+        from PIL import Image
+    except ImportError:
+        print("bench: PIL unavailable, skipping input_pipeline", file=sys.stderr)
+        return None
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        IndexBatch,
+        MetaLearningDataLoader,
+    )
+
+    n_batches = int(
+        os.environ.get("BENCH_INPUT_PIPELINE_BATCHES", "2" if reduced else "3")
+    )
+    n_way = cfg.num_classes_per_set
+    per_class = cfg.num_samples_per_class + cfg.num_target_samples + 2
+    h, w, c = cfg.im_shape
+    root = tempfile.mkdtemp(prefix="bench_input_")
+    try:
+        rng = np.random.RandomState(0)
+        data_dir = os.path.join(root, "mini_imagenet_bench")
+        for ci in range(n_way + 1):
+            d = os.path.join(data_dir, "train", f"n{ci:04d}")
+            os.makedirs(d, exist_ok=True)
+            for j in range(per_class):
+                arr = rng.randint(0, 255, (h, w, c), dtype=np.uint8)
+                img = arr[:, :, 0] if c == 1 else arr
+                Image.fromarray(img, "L" if c == 1 else "RGB").save(
+                    os.path.join(d, f"im{j}.png")
+                )
+        tiers = {}
+        for placement in ("host", "uint8_stream", "device"):
+            pcfg = cfg.replace(
+                dataset_name="mini_imagenet_bench",
+                dataset_path=data_dir,
+                sets_are_pre_split=True,
+                indexes_of_folders_indicating_class=[-3, -2],
+                use_mmap_cache=True,
+                data_placement=placement,
+                cache_dir=os.path.join(root, "cache"),
+                prefetch_batches=2,
+            )
+            loader = MetaLearningDataLoader(
+                pcfg, cache_dir=os.path.join(root, "cache"),
+                shard_id=0, num_shards=1,
+            )
+            loader.pop_stream_stats()
+            h2d_bytes = 0
+            for batch in loader.get_train_batches(total_batches=n_batches):
+                if isinstance(batch, IndexBatch):
+                    h2d_bytes += batch.gather.nbytes + batch.rot_k.nbytes
+                else:
+                    h2d_bytes += sum(int(a.nbytes) for a in batch[:4])
+            stats = loader.pop_stream_stats()
+            denom = max(1, stats["batches"])
+            tiers[placement] = {
+                "h2d_bytes_per_step": int(h2d_bytes / n_batches),
+                "assembly_ms_per_step": round(
+                    stats["assembly_s"] / denom * 1e3, 3
+                ),
+                "producer_stall_ms_per_step": round(
+                    stats["stall_s"] / denom * 1e3, 3
+                ),
+            }
+        return {"tasks_per_step": cfg.global_tasks_per_batch, **tiers}
+    except Exception as e:  # noqa: BLE001 - informational metric only
+        print(f"bench: input_pipeline measurement failed ({e!r})",
+              file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 # BENCH_* env vars that change WHAT is measured (workload shapes or
 # lowering); a run with any of these set must never refresh the baseline
 _WORKLOAD_KNOBS = (
@@ -470,6 +558,12 @@ def main() -> None:
             cfg, state, (x_s, y_s, x_t, y_t), reduced
         )
 
+    # three-tier input pipeline (host / uint8_stream / device): null when
+    # skipped or unmeasurable (sweep points rank train throughput only)
+    input_pipeline = None
+    if os.environ.get("BENCH_SKIP_INPUT_PIPELINE") != "1":
+        input_pipeline = _measure_input_pipeline(cfg, reduced)
+
     peak = _peak_flops(device_kind, cfg.compute_dtype)
     # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
     # recompute) over peak. hfu: *executed* FLOPs per XLA's cost analysis of
@@ -527,6 +621,9 @@ def main() -> None:
         # the serial tail between epochs: fused-val + checkpoint seconds
         # (informational — not part of baseline comparability)
         "epoch_boundary": epoch_boundary,
+        # per-tier H2D bytes/step + host assembly/stall ms (informational —
+        # not part of baseline comparability)
+        "input_pipeline": input_pipeline,
         # pinned workload descriptor: makes round-over-round lines
         # self-describing so a knob-default change can never silently turn
         # the driver series into an apples-to-oranges trend
@@ -581,7 +678,8 @@ def main() -> None:
         baseline_out = {
             k: v for k, v in result.items()
             if k not in ("vs_baseline", "baseline_backend",
-                         "baseline_refreshed", "epoch_boundary")
+                         "baseline_refreshed", "epoch_boundary",
+                         "input_pipeline")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

@@ -153,6 +153,21 @@ class MAMLConfig:
     cache_dir: str = ""  # where dataset path-index JSON caches go ('' => experiment dir)
     use_mmap_cache: bool = False  # preprocessed uint8 memmap image cache (data/preprocess.py)
     prefetch_batches: int = 2  # host->device pipeline depth
+    # where episode pixels are assembled (ops/device_pipeline.py):
+    # 'host'         — the classic path: host threads gather/decode/augment
+    #                  float32 NHWC arrays and upload them every dispatch
+    #                  (~8.5 MB/task for Mini-ImageNet);
+    # 'uint8_stream' — host gathers/rotates raw uint8; decode (float cast,
+    #                  /255, stat-normalize) runs on device — 4x less H2D,
+    #                  no residency requirement;
+    # 'device'       — the split's whole uint8 image store lives in HBM
+    #                  (uploaded once); host episode RNG emits only int32
+    #                  gather/rot-k index tensors (a few KB/batch) and
+    #                  gather+decode+rot90 run inside the jitted step.
+    # Both non-host tiers require use_mmap_cache (the flat uint8 store) and
+    # exclude CIFAR (its per-image RNG crop/flip can't be vectorized on
+    # device); bit-exact with the host path by construction (tested).
+    data_placement: str = "host"  # 'host' | 'uint8_stream' | 'device'
     # outer-loop updates fused into ONE device dispatch (lax.scan over
     # stacked batches). >1 amortizes per-dispatch host round-trips — vital
     # over networked device transports (remote-TPU tunnel: ~0.5s/dispatch
@@ -247,6 +262,31 @@ class MAMLConfig:
                 f"input_layout must be 'auto', 'nhwc' or 'nchw', got "
                 f"{self.input_layout!r}"
             )
+        if self.data_placement not in ("host", "uint8_stream", "device"):
+            raise ValueError(
+                f"data_placement must be 'host', 'uint8_stream' or 'device', "
+                f"got {self.data_placement!r}"
+            )
+        if self.data_placement != "host":
+            # validated HERE, at config time, so a wrong combination fails
+            # with a clear message instead of a silent wrong-numbers path
+            # deep inside the loader/step machinery
+            if "cifar" in self.dataset_name:
+                raise ValueError(
+                    f"data_placement={self.data_placement!r} is not "
+                    f"supported for dataset {self.dataset_name!r}: CIFAR's "
+                    "train-time augmentation (random crop + flip) draws "
+                    "per-image randomness from the episode RNG stream and "
+                    "cannot be vectorized into the on-device pipeline; use "
+                    "data_placement='host' for CIFAR configs"
+                )
+            if not self.use_mmap_cache:
+                raise ValueError(
+                    f"data_placement={self.data_placement!r} requires "
+                    "use_mmap_cache=true: the on-device pipeline gathers "
+                    "from the flat uint8 image store that only the mmap "
+                    "cache builds (data/preprocess.py)"
+                )
         if self.remat_policy not in ("full", "save_conv"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'save_conv', got "

@@ -42,6 +42,7 @@ import optax
 
 from ..config import MAMLConfig
 from ..models import vgg
+from ..ops import device_pipeline
 from ..ops import functional as F
 from . import lslr as lslr_lib
 from . import msl as msl_lib
@@ -267,13 +268,29 @@ def make_grads_fn(cfg: MAMLConfig, second_order: bool):
     return grads_fn
 
 
-def make_train_step(cfg: MAMLConfig, second_order: bool):
+def _decode_prelude(cfg: MAMLConfig, decode_uint8: Optional[bool]):
+    """The in-jit uint8 decode for ``data_placement='uint8_stream'`` batches
+    (None => follow the config), or None when batches arrive as float32."""
+    if decode_uint8 is None:
+        decode_uint8 = cfg.data_placement == "uint8_stream"
+    return device_pipeline.make_decoder(cfg) if decode_uint8 else None
+
+
+def make_train_step(
+    cfg: MAMLConfig, second_order: bool, decode_uint8: Optional[bool] = None
+):
     """Build the jitted outer step: vmap over tasks, grad, Adam.
 
     Signature: (state, x_s, y_s, x_t, y_t, loss_weights, lr) -> (state, metrics)
+
+    Under ``data_placement='uint8_stream'`` the x batches arrive as raw
+    uint8 (host gathered + rotated, decode deferred) and the step decodes
+    them on device as a prelude; ``decode_uint8`` overrides the gate (the
+    indexed path decodes inside its own expander).
     """
     num_steps = cfg.number_of_training_steps_per_iter
     learner = _task_learner(cfg, num_steps, second_order)
+    decode = _decode_prelude(cfg, decode_uint8)
 
     def train_step(state: MetaState, x_s, y_s, x_t, y_t, loss_weights, lr):
         # precision is scoped to this step's trace (not process-global jax
@@ -282,6 +299,8 @@ def make_train_step(cfg: MAMLConfig, second_order: bool):
         # (measured: 20-way val 14% vs 65% at 100 iters) — and two coexisting
         # systems with different compute_dtype must not leak settings into
         # each other's lazily-traced steps
+        if decode is not None:
+            x_s, x_t = decode(x_s), decode(x_t)
         with jax.default_matmul_precision(cfg.resolved_matmul_precision):
             return _train_step_body(state, x_s, y_s, x_t, y_t, loss_weights, lr)
 
@@ -380,7 +399,7 @@ def make_eval_multi_step(cfg: MAMLConfig, with_preds: bool = False):
     return multi_eval
 
 
-def make_eval_step(cfg: MAMLConfig):
+def make_eval_step(cfg: MAMLConfig, decode_uint8: Optional[bool] = None):
     """Build the jitted evaluation step.
 
     Reference semantics (few_shot_learning_system.py:311-323,371-397): always
@@ -391,13 +410,18 @@ def make_eval_step(cfg: MAMLConfig):
 
     Returns (metrics, per_task_softmax_preds) — the preds feed the top-5
     checkpoint ensemble (experiment_builder.py:247-300).
+
+    ``decode_uint8``: same uint8_stream prelude gate as ``make_train_step``.
     """
     num_steps = cfg.number_of_evaluation_steps_per_iter
     learner = _task_learner(cfg, num_steps, second_order=False)
     loss_weights = jnp.asarray(msl_lib.final_step_only(num_steps))
+    decode = _decode_prelude(cfg, decode_uint8)
 
     def eval_step(state: MetaState, x_s, y_s, x_t, y_t):
         # same per-step precision scoping as train_step (see there)
+        if decode is not None:
+            x_s, x_t = decode(x_s), decode(x_t)
         with jax.default_matmul_precision(cfg.resolved_matmul_precision):
             losses, (correct, _, preds) = _map_tasks(
                 lambda xs, ys, xt, yt: learner(
@@ -410,3 +434,79 @@ def make_eval_step(cfg: MAMLConfig):
             return metrics, preds
 
     return eval_step
+
+
+# -- device-resident (index-only H2D) step variants -------------------------
+#
+# ``data_placement='device'``: the split's uint8 image store is resident in
+# HBM and the host ships only int32 gather/rot-k tensors per batch; the
+# gather -> decode -> rot90 expansion (ops.device_pipeline) runs as a prelude
+# inside the same jitted program as the step. ``augment`` is a static trace
+# parameter (per-set: train-time Omniglot only), mirroring the host
+# ``augment_stack`` gate.
+
+
+def make_train_step_indexed(cfg: MAMLConfig, second_order: bool, augment: bool):
+    """Signature: (state, store, gather, rot_k, loss_weights, lr) ->
+    (state, metrics) — ``make_train_step`` with the on-device episode
+    expansion in front; identical math to the host pixel path."""
+    step = make_train_step(cfg, second_order, decode_uint8=False)
+    expand = device_pipeline.make_index_expander(cfg, augment)
+
+    def train_step(state: MetaState, store, gather, rot_k, loss_weights, lr):
+        x_s, y_s, x_t, y_t = expand(store, gather, rot_k)
+        return step(state, x_s, y_s, x_t, y_t, loss_weights, lr)
+
+    return train_step
+
+
+def make_train_multi_step_indexed(
+    cfg: MAMLConfig, second_order: bool, augment: bool
+):
+    """The ``steps_per_dispatch`` twin of ``make_train_step_indexed``: scan
+    over a leading k axis of (gather, rot_k) — the resident store is a scan
+    invariant, NOT scanned over, so K fused updates still upload only K·(a
+    few KB) of indices."""
+    step = make_train_step_indexed(cfg, second_order, augment)
+
+    def multi_step(state, store, gather, rot_k, loss_weights, lr):
+        def body(st, batch):
+            g, r = batch
+            st, metrics = step(st, store, g, r, loss_weights, lr)
+            return st, metrics
+
+        return jax.lax.scan(body, state, (gather, rot_k))
+
+    return multi_step
+
+
+def make_eval_step_indexed(cfg: MAMLConfig, augment: bool = False):
+    """Signature: (state, store, gather, rot_k) -> (metrics, preds) — the
+    evaluation twin of ``make_train_step_indexed``."""
+    step = make_eval_step(cfg, decode_uint8=False)
+    expand = device_pipeline.make_index_expander(cfg, augment)
+
+    def eval_step(state: MetaState, store, gather, rot_k):
+        x_s, y_s, x_t, y_t = expand(store, gather, rot_k)
+        return step(state, x_s, y_s, x_t, y_t)
+
+    return eval_step
+
+
+def make_eval_multi_step_indexed(
+    cfg: MAMLConfig, with_preds: bool = False, augment: bool = False
+):
+    """The ``eval_batches_per_dispatch`` twin of ``make_eval_step_indexed``
+    (same stacked-metrics/preds contract as ``make_eval_multi_step``)."""
+    step = make_eval_step_indexed(cfg, augment)
+
+    def multi_eval(state: MetaState, store, gather, rot_k):
+        def body(st, batch):
+            g, r = batch
+            metrics, preds = step(st, store, g, r)
+            return st, (metrics, preds if with_preds else None)
+
+        _, (metrics, preds) = jax.lax.scan(body, state, (gather, rot_k))
+        return metrics, preds
+
+    return multi_eval

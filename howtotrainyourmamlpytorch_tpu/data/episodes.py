@@ -156,6 +156,53 @@ InMemoryClass = np.ndarray  # (num_images, h, w, c)
 ClassStore = Dict[str, Union[list, InMemoryClass]]  # paths or decoded arrays
 
 
+class IndexEpisode(NamedTuple):
+    """One few-shot task as flat-store indices only — the index-only H2D
+    form of ``Episode`` (data_placement='device'/'uint8_stream').
+
+    ``gather[i, j]`` is the flat row (into a ``preprocess.FlatStore``) of the
+    j-th sample of episode-class i; columns ``[:spc]`` are support,
+    ``[spc:]`` target. ``rot_k[i]`` is class i's rot90 draw (always drawn —
+    stream parity — applied only for train-time Omniglot). Labels need no
+    tensor at all: sample (i, j) has label i by construction.
+    """
+
+    gather: np.ndarray  # (n_way, spc + nts) int32
+    rot_k: np.ndarray  # (n_way,) int32
+    seed: int
+
+
+def sample_episode_indices(
+    cfg: MAMLConfig,
+    offsets: Dict[str, int],
+    sizes: Dict[str, int],
+    class_keys: np.ndarray,
+    seed: int,
+) -> IndexEpisode:
+    """Draw one task as gather indices into a flat store.
+
+    Bit-for-bit the same four-draw RNG discipline as ``sample_episode`` (see
+    module docstring) — the per-class draw is over ``sizes[key]``, exactly
+    the ``len(store)`` the pixel path uses — so for any seed,
+    ``store.data[gather]`` is the pixel path's pre-decode gather, identically.
+    CIFAR is excluded (config-time check): its per-image crop/flip draws from
+    the episode RNG mid-stream, which an index-only emission cannot replay.
+    """
+    rng = np.random.RandomState(seed)
+    selected = rng.choice(class_keys, size=cfg.num_classes_per_set, replace=False)
+    rng.shuffle(selected)
+    k_list = rng.randint(0, 4, size=cfg.num_classes_per_set)
+
+    spc, nts = cfg.num_samples_per_class, cfg.num_target_samples
+    rows = np.empty((cfg.num_classes_per_set, spc + nts), np.int32)
+    for episode_label, class_key in enumerate(selected):
+        sample_idx = rng.choice(sizes[class_key], size=spc + nts, replace=False)
+        rows[episode_label] = offsets[class_key] + sample_idx
+    return IndexEpisode(
+        gather=rows, rot_k=k_list.astype(np.int32), seed=seed
+    )
+
+
 def sample_episode(
     cfg: MAMLConfig,
     classes: ClassStore,

@@ -24,15 +24,48 @@ from __future__ import annotations
 import concurrent.futures
 import queue
 import threading
-from typing import Dict, Iterator, Optional, Tuple
+import time
+from typing import Dict, Iterator, NamedTuple, Optional, Tuple, Union
 
 import numpy as np
 
 from ..config import MAMLConfig
 from . import datasets as ds
-from .episodes import Episode, sample_episode
+from .episodes import Episode, IndexEpisode, sample_episode, sample_episode_indices
 
 Batch = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+class IndexBatch(NamedTuple):
+    """A stacked batch of ``IndexEpisode``s — the index-only H2D form the
+    ``data_placement='device'`` tier ships instead of pixels (a few KB vs
+    ~100 MB of float32 for a Mini-ImageNet 12-task batch).
+
+    ``gather[t, i, j]`` is the flat-store row of task t / episode-class i /
+    sample j (columns ``[:spc]`` support, ``[spc:]`` target); ``rot_k`` the
+    per-(task, class) rot90 draws. ``set_name``/``augment`` tell the system
+    which resident store to gather from and whether the (static) rotation
+    branch is traced in. Labels are implicit (sample (t, i, j) has label i)
+    and are materialised on device by an iota — see ``target_labels`` for the
+    host-side copy the test ensemble needs.
+    """
+
+    gather: np.ndarray  # (tasks, n_way, spc + nts) int32
+    rot_k: np.ndarray  # (tasks, n_way) int32
+    seeds: np.ndarray  # (tasks,) int64
+    set_name: str
+    augment: bool
+
+    def target_labels(self, num_target_samples: int) -> np.ndarray:
+        """(tasks, n_way, nts) int32 — the host-side ``y_target`` twin."""
+        tasks, n, _ = self.gather.shape
+        return np.tile(
+            np.arange(n, dtype=np.int32)[None, :, None],
+            (tasks, 1, num_target_samples),
+        )
+
+
+AnyBatch = Union[Batch, IndexBatch]
 
 
 class FewShotEpisodicDataset:
@@ -46,10 +79,17 @@ class FewShotEpisodicDataset:
         self.seed = dict(self.init_seed)
         index, idx_to_label, label_to_idx = ds.load_class_index(cfg, cache_dir)
         self.splits = ds.split_classes(cfg, index, idx_to_label, self.seed["val"])
+        # flat uint8 stores (preprocess.FlatStore) back the non-host
+        # data_placement tiers; the per-class views served to the pixel path
+        # are slices of the same memmap, so all tiers read identical bytes
+        self.flat_stores: Dict[str, "FlatStore"] = {}
         if cfg.use_mmap_cache:
-            from .preprocess import build_mmap_cache
+            from .preprocess import build_mmap_cache_flat
 
-            self.splits = build_mmap_cache(cfg, self.splits, cache_dir)
+            self.flat_stores = build_mmap_cache_flat(cfg, self.splits, cache_dir)
+            self.splits = {
+                name: fs.views() for name, fs in self.flat_stores.items()
+            }
         elif cfg.load_into_memory:
             self.splits = ds.preload_to_memory(cfg, self.splits)
         # class-key ordering per set is the dict insertion order — the
@@ -76,6 +116,50 @@ class FewShotEpisodicDataset:
             self.class_keys[set_name],
             seed=self.seed[set_name] + idx,
             augment=augment,
+        )
+
+    def episode_indices(self, set_name: str, idx: int) -> IndexEpisode:
+        """The index-only form of ``episode`` (same RNG stream, no pixels) —
+        the ``data_placement='device'`` sampler."""
+        flat = self.flat_stores[set_name]
+        return sample_episode_indices(
+            self.cfg,
+            flat.offsets,
+            flat.sizes,
+            self.class_keys[set_name],
+            seed=self.seed[set_name] + idx,
+        )
+
+    def episode_uint8(self, set_name: str, idx: int, augment: bool) -> Episode:
+        """One task's raw uint8 pixels, gathered + rotated on host, decode
+        deferred to the device (``data_placement='uint8_stream'``).
+
+        rot90 on integer pixels commutes with the elementwise decode, so
+        device-decoding this Episode reproduces the float path bit-exactly
+        (and moves 4x fewer H2D bytes).
+        """
+        cfg = self.cfg
+        ie = self.episode_indices(set_name, idx)
+        x = self.flat_stores[set_name].data[ie.gather]  # (n, spc+nts, h, w, c)
+        if augment and "omniglot" in cfg.dataset_name:
+            x = np.stack(
+                [
+                    np.rot90(x[i], k=int(k), axes=(1, 2))
+                    for i, k in enumerate(ie.rot_k)
+                ]
+            )
+        x = np.ascontiguousarray(x)
+        spc, nts = cfg.num_samples_per_class, cfg.num_target_samples
+        y = np.tile(
+            np.arange(cfg.num_classes_per_set, dtype=np.int32)[:, None],
+            (1, spc + nts),
+        )
+        return Episode(
+            x_support=x[:, :spc],
+            x_target=x[:, spc:],
+            y_support=y[:, :spc],
+            y_target=y[:, spc:],
+            seed=ie.seed,
         )
 
 
@@ -122,25 +206,79 @@ class MetaLearningDataLoader:
         self.tasks_per_shard = self.tasks_per_batch // self.num_shards
         self.dataset = FewShotEpisodicDataset(cfg, cache_dir)
         self.total_train_iters_produced = 0
+        # input-pipeline telemetry (bench.py `input_pipeline`): cumulative
+        # episode-assembly seconds, producer-queue stall seconds (time the
+        # producer sat blocked in put() against a full queue), batches
+        # produced. Guarded by a lock: train and val producers can overlap.
+        self._stats_lock = threading.Lock()
+        self.stream_stats = {"assembly_s": 0.0, "stall_s": 0.0, "batches": 0}
+        self._last_producer_thread: Optional[threading.Thread] = None
         self.continue_from_iter(current_iter)
+
+    def pop_stream_stats(self) -> Dict[str, float]:
+        """Return and reset the cumulative producer telemetry."""
+        with self._stats_lock:
+            out = dict(self.stream_stats)
+            self.stream_stats = {"assembly_s": 0.0, "stall_s": 0.0, "batches": 0}
+        return out
 
     def continue_from_iter(self, current_iter: int) -> None:
         """Fast-forward the train stream after resume (data.py:583-588)."""
         self.total_train_iters_produced += current_iter * self.tasks_per_batch
 
+    def _episode_builder(self, set_name: str, augment: bool):
+        """(build, stack) for the configured placement tier: host float32
+        pixels, raw uint8 pixels (device decode), or index-only tensors."""
+        placement = self.cfg.data_placement
+        dataset = self.dataset
+        if placement == "device":
+            def stack_indices(eps) -> IndexBatch:
+                return IndexBatch(
+                    gather=np.stack([e.gather for e in eps]),
+                    rot_k=np.stack([e.rot_k for e in eps]),
+                    seeds=np.array([e.seed for e in eps], np.int64),
+                    set_name=set_name,
+                    augment=augment,
+                )
+
+            return (
+                lambda i: dataset.episode_indices(set_name, i),
+                stack_indices,
+            )
+        if placement == "uint8_stream":
+            return (
+                lambda i: dataset.episode_uint8(set_name, i, augment),
+                _stack,
+            )
+        return lambda i: dataset.episode(set_name, i, augment), _stack
+
     def _batches(
         self, set_name: str, total_batches: int, augment: bool
-    ) -> Iterator[Batch]:
+    ) -> Iterator[AnyBatch]:
         cfg = self.cfg
-        dataset = self.dataset
         tpb = self.tasks_per_batch
         workers = max(1, cfg.num_dataprovider_workers)
         prefetch = max(1, cfg.prefetch_batches)
         out: "queue.Queue" = queue.Queue(maxsize=prefetch)
         stop = threading.Event()
+        build, stack = self._episode_builder(set_name, augment)
 
         lo = self.shard_id * self.tasks_per_shard
         hi = lo + self.tasks_per_shard
+
+        def put(item) -> bool:
+            # timed/poll put, NOT a bare out.put(): when the consumer
+            # abandons the generator while this thread is parked in a
+            # blocking put() against a full queue, the consumer-side
+            # stop.set() is never observed and the thread leaks forever —
+            # poll so `stop` always gets a look-in
+            while not stop.is_set():
+                try:
+                    out.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
@@ -150,18 +288,22 @@ class MetaLearningDataLoader:
                             return
                         # this host's slice of the global batch's task range
                         idxs = range(b * tpb + lo, b * tpb + hi)
-                        eps = list(
-                            pool.map(
-                                lambda i: dataset.episode(set_name, i, augment),
-                                idxs,
-                            )
-                        )
-                        out.put(_stack(eps))
-                out.put(None)
+                        t0 = time.perf_counter()
+                        batch = stack(list(pool.map(build, idxs)))
+                        t1 = time.perf_counter()
+                        if not put(batch):
+                            return
+                        t2 = time.perf_counter()
+                        with self._stats_lock:
+                            self.stream_stats["assembly_s"] += t1 - t0
+                            self.stream_stats["stall_s"] += t2 - t1
+                            self.stream_stats["batches"] += 1
+                put(None)
             except BaseException as exc:  # surface worker errors to consumer
-                out.put(exc)
+                put(exc)
 
         thread = threading.Thread(target=producer, daemon=True)
+        self._last_producer_thread = thread  # exposed for tests/diagnostics
         thread.start()
         try:
             while True:
@@ -176,7 +318,7 @@ class MetaLearningDataLoader:
 
     def get_train_batches(
         self, total_batches: int, augment_images: bool = False
-    ) -> Iterator[Batch]:
+    ) -> Iterator[AnyBatch]:
         self.dataset.update_train_seed(self.total_train_iters_produced)
         # advanced once per generator CALL, not per batch — reference quirk
         # the resume arithmetic depends on (data.py:598-602)
@@ -185,10 +327,10 @@ class MetaLearningDataLoader:
 
     def get_val_batches(
         self, total_batches: int, augment_images: bool = False
-    ) -> Iterator[Batch]:
+    ) -> Iterator[AnyBatch]:
         return self._batches("val", total_batches, augment_images)
 
     def get_test_batches(
         self, total_batches: int, augment_images: bool = False
-    ) -> Iterator[Batch]:
+    ) -> Iterator[AnyBatch]:
         return self._batches("test", total_batches, augment_images)

@@ -38,7 +38,7 @@ import glob
 import json
 import os
 import time
-from typing import Dict, List
+from typing import Dict, List, NamedTuple
 
 import numpy as np
 
@@ -57,6 +57,28 @@ from .episodes import load_image_uint8
 # cache are bit-identical by construction
 
 
+class FlatStore(NamedTuple):
+    """One set's images as a single flat uint8 array plus the class layout.
+
+    ``data`` is the (total, h, w, c) memmap the cache serves per-class views
+    of; ``offsets[key] + j`` is the flat row of class ``key``'s j-th image.
+    This is the indexable form the device-resident pipeline uploads to HBM
+    once (ops/device_pipeline.py): episode sampling then only needs
+    ``offsets``/``sizes`` to turn per-class draws into flat gather indices.
+    """
+
+    data: np.ndarray  # (total, h, w, c) uint8
+    offsets: Dict[str, int]  # class key -> first flat row
+    sizes: Dict[str, int]  # class key -> image count
+
+    def views(self) -> Dict[str, np.ndarray]:
+        """Per-class array views (the classic ``build_set_cache`` shape)."""
+        return {
+            key: self.data[off : off + self.sizes[key]]
+            for key, off in self.offsets.items()
+        }
+
+
 def _cache_base(cfg: MAMLConfig, cache_dir: str, set_name: str) -> str:
     h, w, c = cfg.im_shape
     return os.path.join(
@@ -68,7 +90,15 @@ def build_set_cache(
     cfg: MAMLConfig, classes: ClassIndex, cache_dir: str, set_name: str,
     workers: int = 8,
 ) -> Dict[str, np.ndarray]:
-    """Build (or reuse) one set's memmap cache; return class -> uint8 view.
+    """Build (or reuse) one set's memmap cache; return class -> uint8 view."""
+    return build_set_cache_flat(cfg, classes, cache_dir, set_name, workers).views()
+
+
+def build_set_cache_flat(
+    cfg: MAMLConfig, classes: ClassIndex, cache_dir: str, set_name: str,
+    workers: int = 8,
+) -> FlatStore:
+    """Build (or reuse) one set's memmap cache; return its ``FlatStore``.
 
     Class order and per-class counts are recorded so a cache is only reused
     when it matches the current split exactly.
@@ -200,12 +230,14 @@ def build_set_cache(
                     os.remove(tmp)
 
     mm = np.memmap(data_path, mode="r", dtype=np.uint8, shape=(total, h, w, c))
-    views: Dict[str, np.ndarray] = {}
+    offsets: Dict[str, int] = {}
+    sizes: Dict[str, int] = {}
     offset = 0
     for key, count in zip(order, counts):
-        views[key] = mm[offset : offset + count]
+        offsets[key] = offset
+        sizes[key] = count
         offset += count
-    return views
+    return FlatStore(data=mm, offsets=offsets, sizes=sizes)
 
 
 def build_mmap_cache(
@@ -216,6 +248,19 @@ def build_mmap_cache(
     """Memmap-cache every set of the split (the drop-in alternative to
     ``datasets.preload_to_memory``)."""
     return {
-        set_name: build_set_cache(cfg, classes, cache_dir, set_name)
+        set_name: store.views()
+        for set_name, store in build_mmap_cache_flat(cfg, splits, cache_dir).items()
+    }
+
+
+def build_mmap_cache_flat(
+    cfg: MAMLConfig,
+    splits: Dict[str, ClassIndex],
+    cache_dir: str,
+) -> Dict[str, FlatStore]:
+    """Memmap-cache every set of the split, keeping the flat form the
+    device-resident pipeline needs (set -> ``FlatStore``)."""
+    return {
+        set_name: build_set_cache_flat(cfg, classes, cache_dir, set_name)
         for set_name, classes in splits.items()
     }

@@ -103,6 +103,16 @@ class ExperimentBuilder:
             current_iter=self.state["current_iter"],
             cache_dir=cfg.cache_dir or self.logs_filepath,
         )
+        if cfg.data_placement == "device":
+            # hand the model the per-set flat uint8 stores so it can make
+            # them device-resident (uploaded lazily, once per set); the
+            # loader then ships index-only batches
+            self.model.register_flat_stores(
+                {
+                    name: fs.data
+                    for name, fs in self.data.dataset.flat_stores.items()
+                }
+            )
 
         self.epoch = int(self.state["current_iter"] // cfg.total_iter_per_epoch)
         self.state["best_epoch"] = int(
@@ -204,9 +214,10 @@ class ExperimentBuilder:
     # -- phases -----------------------------------------------------------
 
     def train_iteration(self, train_sample, epoch_idx):
-        x_s, x_t, y_s, y_t = train_sample[:4]
+        # the sample passes through whole: the system dispatches on its form
+        # (pixel tuple — x_s, x_t, y_s, y_t leading — or IndexBatch)
         self._maybe_profile_step()
-        losses = self.model.run_train_iter((x_s, x_t, y_s, y_t), epoch=epoch_idx)
+        losses = self.model.run_train_iter(train_sample, epoch=epoch_idx)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += 1
         # with the model's one-step-lag sync, tick intervals equal device
@@ -224,9 +235,7 @@ class ExperimentBuilder:
             self.train_iteration(train_samples[0], epoch_idx)
             return
         self._maybe_profile_step()
-        losses = self.model.run_train_iters(
-            [(s[0], s[1], s[2], s[3]) for s in train_samples], epoch=epoch_idx
-        )
+        losses = self.model.run_train_iters(list(train_samples), epoch=epoch_idx)
         # ONE accumulation per chunk: device metrics arrive (k,)-stacked and
         # the epoch summary flattens them — per-iteration slicing here would
         # issue 2k tiny device programs per chunk (see run_train_iters)
@@ -262,8 +271,7 @@ class ExperimentBuilder:
             self._profile_done = True
 
     def evaluation_iteration(self, val_sample, total_losses):
-        x_s, x_t, y_s, y_t = val_sample[:4]
-        losses, _ = self.model.run_validation_iter((x_s, x_t, y_s, y_t))
+        losses, _ = self.model.run_validation_iter(val_sample)
         self._accumulate(losses, total_losses)
 
     def evaluation_iterations(self, val_samples, total_losses):
@@ -274,9 +282,7 @@ class ExperimentBuilder:
         if len(val_samples) == 1:
             self.evaluation_iteration(val_samples[0], total_losses)
             return
-        losses, _ = self.model.run_validation_iters(
-            [(s[0], s[1], s[2], s[3]) for s in val_samples]
-        )
+        losses, _ = self.model.run_validation_iters(list(val_samples))
         self._accumulate(losses, total_losses)
 
     def run_validation_epoch(self) -> Dict[str, float]:
@@ -625,19 +631,25 @@ class ExperimentBuilder:
 
         def flush(idx, samples):
             _, preds = self.model.run_validation_iters(
-                [(s[0], s[1], s[2], s[3]) for s in samples],
-                return_preds=True,
+                list(samples), return_preds=True
             )
             if self._active_pbar is not None:
                 self._active_pbar.update(len(samples))
             # preds arrive (k, tasks, targets, classes): per-batch slices
             # keep the sequential path's list-of-task-arrays accumulation
+            from ..data.loader import IndexBatch
+
             for j, sample in enumerate(samples):
                 per_model_preds[idx].extend(list(preds[j]))
                 if idx == 0:
                     # the test stream is identical per call (fixed seed), so
                     # targets only need gathering once, not once per model
-                    t = np.asarray(sample[3])
+                    if isinstance(sample, IndexBatch):
+                        # index-only batches carry no pixel targets; labels
+                        # are positional (sample j of class i has label i)
+                        t = sample.target_labels(self.cfg.num_target_samples)
+                    else:
+                        t = np.asarray(sample[3])
                     all_targets.extend(
                         list(
                             self.model.gather_across_hosts(

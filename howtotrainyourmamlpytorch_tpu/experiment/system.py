@@ -23,6 +23,7 @@ import numpy as np
 
 from ..config import MAMLConfig
 from ..core import maml, msl
+from ..data.loader import IndexBatch
 from ..parallel import mesh as mesh_lib
 from . import checkpoint as ckpt
 
@@ -125,6 +126,15 @@ class MAMLFewShotClassifier:
         self._train_multi_steps: Dict[Any, Any] = {}
         self._eval_step = jax.jit(maml.make_eval_step(cfg))
         self._eval_multi_steps: Dict[bool, Any] = {}
+        # device-resident data path (data_placement='device'): host uint8
+        # stores registered via register_flat_stores, uploaded to HBM lazily
+        # on first use per set; per-batch H2D is then index tensors only
+        self._host_stores: Dict[str, np.ndarray] = {}
+        self._device_stores: Dict[str, Any] = {}
+        self._train_steps_indexed: Dict[Any, Any] = {}
+        self._train_multi_steps_indexed: Dict[Any, Any] = {}
+        self._eval_steps_indexed: Dict[Any, Any] = {}
+        self._eval_multi_steps_indexed: Dict[Any, Any] = {}
         # 1-step-lag sync handle: bounds device run-ahead to one in-flight
         # step (backpressure against queued-input OOM) while still
         # overlapping host work with device compute
@@ -156,13 +166,144 @@ class MAMLFewShotClassifier:
             )
         return self._eval_multi_steps[with_preds]
 
+    def _train_step_indexed(self, second_order: bool, augment: bool):
+        key = (second_order, augment)
+        if key not in self._train_steps_indexed:
+            self._train_steps_indexed[key] = jax.jit(
+                maml.make_train_step_indexed(self.cfg, second_order, augment),
+                donate_argnums=(0,),  # state only — never the resident store
+            )
+        return self._train_steps_indexed[key]
+
+    def _train_multi_step_indexed(self, second_order: bool, augment: bool, k: int):
+        key = (second_order, augment, k)
+        if key not in self._train_multi_steps_indexed:
+            self._train_multi_steps_indexed[key] = jax.jit(
+                maml.make_train_multi_step_indexed(
+                    self.cfg, second_order, augment
+                ),
+                donate_argnums=(0,),
+            )
+        return self._train_multi_steps_indexed[key]
+
+    def _eval_step_indexed(self, augment: bool):
+        if augment not in self._eval_steps_indexed:
+            self._eval_steps_indexed[augment] = jax.jit(
+                maml.make_eval_step_indexed(self.cfg, augment)
+            )
+        return self._eval_steps_indexed[augment]
+
+    def _eval_multi_step_indexed(self, with_preds: bool, augment: bool):
+        key = (with_preds, augment)
+        if key not in self._eval_multi_steps_indexed:
+            self._eval_multi_steps_indexed[key] = jax.jit(
+                maml.make_eval_multi_step_indexed(self.cfg, with_preds, augment)
+            )
+        return self._eval_multi_steps_indexed[key]
+
+    # -- device-resident store management ---------------------------------
+
+    def register_flat_stores(self, stores: Dict[str, np.ndarray]) -> None:
+        """Register per-set host uint8 image stores (``FlatStore.data``) for
+        ``data_placement='device'``. Upload happens lazily on first batch of
+        each set, so sets never evaluated cost no HBM."""
+        self._host_stores.update(stores)
+        self._device_stores.clear()
+
+    def _device_store(self, set_name: str):
+        if set_name not in self._device_stores:
+            if set_name not in self._host_stores:
+                raise ValueError(
+                    f"data_placement='device' but no flat store registered "
+                    f"for set {set_name!r}; call register_flat_stores with "
+                    "the dataset's FlatStore data (the experiment builder "
+                    "does this automatically)"
+                )
+            store = self._host_stores[set_name]
+            if self.multihost:
+                # every host holds the full (deterministically built) store;
+                # replicate it over the global mesh — index batches are what
+                # shard over the task axis (see parallel.mesh.replicate_array)
+                sharding = mesh_lib.replicated(self.mesh)
+                arr = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(store), store.shape
+                )
+            elif self.mesh is not None:
+                arr = mesh_lib.replicate_array(self.mesh, store)
+            else:
+                arr = jax.device_put(store)
+            self._device_stores[set_name] = arr
+        return self._device_stores[set_name]
+
+    def _prepare_index_batch(self, batch: IndexBatch):
+        """Place one IndexBatch's (gather, rot_k) tensors — the task axis
+        shards exactly like the pixel path's, just a few KB instead of MB."""
+        gather = np.ascontiguousarray(batch.gather, np.int32)
+        rot_k = np.ascontiguousarray(batch.rot_k, np.int32)
+        if self.multihost:
+            from ..parallel import distributed
+
+            sharding = distributed.global_batch_sharding(self.mesh)
+            n_hosts = jax.process_count()
+            out = []
+            for a in (gather, rot_k):
+                global_shape = (a.shape[0] * n_hosts,) + a.shape[1:]
+                out.append(
+                    jax.make_array_from_process_local_data(
+                        sharding, a, global_shape
+                    )
+                )
+            return tuple(out)
+        if self.mesh is not None:
+            return mesh_lib.shard_batch(self.mesh, gather, rot_k)
+        return jax.device_put((gather, rot_k))
+
+    def _upload_stacked_indices(self, batches):
+        """Stack per-iteration IndexBatches along a leading k axis and start
+        the (async) upload — the index twin of ``_upload_stacked``."""
+        gather = np.stack([np.asarray(b.gather, np.int32) for b in batches])
+        rot_k = np.stack([np.asarray(b.rot_k, np.int32) for b in batches])
+        if self.mesh is not None:
+            return mesh_lib.shard_stacked_batch(self.mesh, gather, rot_k)
+        return jax.device_put((gather, rot_k))
+
+    def _stage_indexed(self, batch_or_batches, stacked: bool):
+        """The shared prelude of every indexed dispatch: enqueue the (tiny)
+        index upload and resolve the resident store FIRST, then apply the
+        one-step-lag sync — same H2D-overlaps-in-flight-dispatch ordering as
+        the pixel paths. Returns (store, (gather, rot_k), augment)."""
+        if stacked:
+            placed = self._upload_stacked_indices(batch_or_batches)
+            first = batch_or_batches[0]
+        else:
+            placed = self._prepare_index_batch(batch_or_batches)
+            first = batch_or_batches
+        store = self._device_store(first.set_name)
+        if self._pending_sync is not None:
+            jax.block_until_ready(self._pending_sync)
+        return store, placed, first.augment
+
     def _convert_batch(self, data_batch):
         """Layout/dtype conversion only (no device placement):
         (x_s, y_s, x_t, y_t) as host numpy arrays."""
         x_s, x_t, y_s, y_t = data_batch[:4]
         layout, shape = self.cfg.input_layout, self.cfg.im_shape
-        x_s = _to_nhwc(np.asarray(x_s, np.float32), layout, shape)
-        x_t = _to_nhwc(np.asarray(x_t, np.float32), layout, shape)
+        if self.cfg.data_placement == "uint8_stream":
+            # raw integer pixels cross H2D; the jitted step decodes them.
+            # A float batch here would be silently truncated by a uint8
+            # cast — refuse instead (the loader's uint8 tier is the only
+            # legitimate source of these batches)
+            for a in (x_s, x_t):
+                if np.asarray(a).dtype != np.uint8:
+                    raise ValueError(
+                        "data_placement='uint8_stream' expects uint8 image "
+                        f"batches from the loader, got {np.asarray(a).dtype}"
+                    )
+            x_s = _to_nhwc(np.asarray(x_s), layout, shape)
+            x_t = _to_nhwc(np.asarray(x_t), layout, shape)
+        else:
+            x_s = _to_nhwc(np.asarray(x_s, np.float32), layout, shape)
+            x_t = _to_nhwc(np.asarray(x_t, np.float32), layout, shape)
         y_s = np.asarray(y_s, np.int32)
         y_t = np.asarray(y_t, np.int32)
         return x_s, y_s, x_t, y_t
@@ -238,6 +379,22 @@ class MAMLFewShotClassifier:
         epoch = int(epoch)
         self.current_epoch = epoch
         lr, weights, second_order, anneal = self._epoch_schedule(epoch)
+        if isinstance(data_batch, IndexBatch):
+            # device-resident tier: upload a few KB of indices, gather /
+            # decode / rot90 run inside the jitted step against the
+            # resident store
+            store, (gather, rot_k), augment = self._stage_indexed(
+                data_batch, stacked=False
+            )
+            self.state, metrics = self._train_step_indexed(
+                second_order, augment
+            )(self.state, store, gather, rot_k, weights, lr)
+            self._pending_sync = metrics["loss"]
+            losses = dict(metrics)
+            for i, w in enumerate(anneal):
+                losses[f"loss_importance_vector_{i}"] = float(w)
+            losses["learning_rate"] = float(lr)
+            return losses
         x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
         # wait for the PREVIOUS step before enqueuing the next: a one-step
         # pipeline. (Zero sync would let the host run an epoch ahead, pinning
@@ -291,8 +448,21 @@ class MAMLFewShotClassifier:
         epoch = int(epoch)
         self.current_epoch = epoch
         lr, weights, second_order, anneal = self._epoch_schedule(epoch)
+        k = len(data_batches)
+        if isinstance(data_batches[0], IndexBatch):
+            store, placed, augment = self._stage_indexed(
+                data_batches, stacked=True
+            )
+            self.state, metrics = self._train_multi_step_indexed(
+                second_order, augment, k
+            )(self.state, store, *placed, weights, lr)
+            self._pending_sync = metrics["loss"]
+            losses = dict(metrics)  # values are (k,) device arrays
+            for j, w in enumerate(anneal):
+                losses[f"loss_importance_vector_{j}"] = float(w)
+            losses["learning_rate"] = float(lr)
+            return losses
         prepared = [self._convert_batch(b) for b in data_batches]
-        k = len(prepared)
         stacked = self._upload_stacked(prepared)
         # upload already enqueued above — blocking here only bounds run-ahead
         # to one in-flight dispatch while this chunk's H2D streams in
@@ -318,10 +488,18 @@ class MAMLFewShotClassifier:
         on the host (cross-host allgather in multihost mode) — only the test
         ensemble needs them; plain validation skips the transfer entirely.
         """
-        x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
-        if self._pending_sync is not None:  # same one-step pipeline as train
-            jax.block_until_ready(self._pending_sync)
-        metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
+        if isinstance(data_batch, IndexBatch):
+            store, (gather, rot_k), augment = self._stage_indexed(
+                data_batch, stacked=False
+            )
+            metrics, preds = self._eval_step_indexed(augment)(
+                self.state, store, gather, rot_k
+            )
+        else:
+            x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
+            if self._pending_sync is not None:  # same one-step pipeline as train
+                jax.block_until_ready(self._pending_sync)
+            metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
         self._pending_sync = metrics["loss"]
         metrics = dict(metrics)  # device arrays; caller converts on summary
         out_preds = None
@@ -364,13 +542,21 @@ class MAMLFewShotClassifier:
                 np.stack([p for _, p in per_iter]) if return_preds else None
             )
             return losses, preds
-        prepared = [self._convert_batch(b) for b in data_batches]
-        stacked = self._upload_stacked(prepared)
-        if self._pending_sync is not None:  # same one-step pipeline as train
-            jax.block_until_ready(self._pending_sync)
-        metrics, preds = self._eval_multi_step(return_preds)(
-            self.state, *stacked
-        )
+        if isinstance(data_batches[0], IndexBatch):
+            store, placed, augment = self._stage_indexed(
+                data_batches, stacked=True
+            )
+            metrics, preds = self._eval_multi_step_indexed(
+                return_preds, augment
+            )(self.state, store, *placed)
+        else:
+            prepared = [self._convert_batch(b) for b in data_batches]
+            stacked = self._upload_stacked(prepared)
+            if self._pending_sync is not None:  # same one-step pipeline as train
+                jax.block_until_ready(self._pending_sync)
+            metrics, preds = self._eval_multi_step(return_preds)(
+                self.state, *stacked
+            )
         self._pending_sync = metrics["loss"]
         out_preds = np.asarray(preds) if return_preds else None
         return dict(metrics), out_preds

@@ -1,0 +1,138 @@
+"""On-device episode assembly: gather -> decode -> rot90 inside the jit.
+
+The host episode path (data/episodes.py) assembles float32 NHWC arrays with
+GIL-bound threads and uploads ~4 bytes/subpixel per dispatch. This module
+moves the pixel work into the jitted step so the host ships either
+
+* raw **uint8** batches (``data_placement='uint8_stream'``): host gathers and
+  rotates integer pixels, the device does the float cast / ``/255`` /
+  stat-normalize — a 4x H2D reduction with no residency requirement; or
+* **int32 index tensors only** (``data_placement='device'``): the split's
+  flat uint8 store (preprocess.FlatStore) lives in HBM, uploaded once;
+  per-batch H2D is a few KB of gather/rot-k indices and the gather itself
+  runs on device.
+
+Bit-exactness with the host path holds by construction: the decode applies
+the *same* float ops in the *same* order as ``episodes.decode_cached`` +
+``episodes.augment_stack`` (float32 cast; ``/255`` for non-Omniglot — the
+Omniglot unrescaled-cast quirk preserved; RGB->BGR flip when
+``reverse_channels``; ImageNet-stat normalize for the imagenet family), and
+rot90 on integer pixels commutes with the elementwise decode. CIFAR is
+excluded at config time: its per-image random crop/flip draws from the
+episode RNG mid-stream and cannot be replayed from indices.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MAMLConfig
+
+
+def _decode_lut(cfg: MAMLConfig) -> np.ndarray:
+    """(256, c) float32 lookup: ``lut[v, ch]`` is the host decode of uint8
+    value v in channel ch.
+
+    Built on the HOST by running the host pipeline itself
+    (``episodes.decode_cached`` + ``augment_stack``'s normalization rules)
+    over all 256 possible subpixel values — so device decode is bit-exact
+    with the host path *by construction*, immune to XLA rewriting
+    ``x / 255`` into a multiply-by-reciprocal (CPU fast-math does, measured
+    ULP-level drift) or fusing the normalize into FMAs. A (256·c)-entry
+    gather is also cheaper on device than three elementwise passes.
+    """
+    from ..data.episodes import augment_stack, decode_cached
+
+    c = cfg.image_channels
+    vals = np.tile(
+        np.arange(256, dtype=np.uint8)[:, None, None, None], (1, 1, 1, c)
+    )
+    # the channel flip is handled on the uint8 indices (see make_decoder);
+    # on this constant-per-channel probe it would be an identity anyway
+    cfg_noflip = cfg.replace(reverse_channels=False)
+    out = decode_cached(cfg_noflip, vals)  # cast (+ /255 unless Omniglot)
+    out = augment_stack(cfg_noflip, out, k=0, augment=False)  # stat-normalize
+    return np.ascontiguousarray(out.reshape(256, c))
+
+
+def make_decoder(cfg: MAMLConfig) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """uint8 pixels -> the reference's float32 values, inside jit.
+
+    The device twin of ``episodes.decode_cached`` followed by
+    ``episodes.augment_stack``'s normalization rules (rotation excluded —
+    see ``make_index_expander``), realised as a per-channel value lookup so
+    the outputs are bit-identical to the host path (see ``_decode_lut``).
+    """
+    lut = jnp.asarray(_decode_lut(cfg))
+    chan = jnp.arange(cfg.image_channels)
+
+    def decode(x: jnp.ndarray) -> jnp.ndarray:
+        if cfg.reverse_channels:
+            # RGB->BGR before the (per-output-channel) lookup — equivalent
+            # to the host's flip-after-scale because the scale step is
+            # channel-independent
+            x = x[..., ::-1]
+        return lut[x.astype(jnp.int32), chan]
+
+    return decode
+
+
+def _rot_stack(imgs: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """rot90 an (s, h, w, c) stack by a *traced* k in {0,1,2,3}.
+
+    ``lax.switch`` over the four static rotations (jnp.rot90 needs a static
+    k); all branches must agree on shape, hence the square-image requirement
+    enforced in ``make_index_expander``.
+    """
+    return jax.lax.switch(
+        k,
+        [
+            lambda x: x,
+            lambda x: jnp.rot90(x, 1, axes=(1, 2)),
+            lambda x: jnp.rot90(x, 2, axes=(1, 2)),
+            lambda x: jnp.rot90(x, 3, axes=(1, 2)),
+        ],
+        imgs,
+    )
+
+
+def make_index_expander(
+    cfg: MAMLConfig, augment: bool
+) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """(store, gather, rot_k) -> (x_s, y_s, x_t, y_t), all on device.
+
+    ``store`` is the resident (N, h, w, c) uint8 image store; ``gather`` the
+    (tasks, n_way, spc+nts) int32 flat indices and ``rot_k`` the
+    (tasks, n_way) int32 rotation draws from
+    ``episodes.sample_episode_indices``. Labels never cross H2D at all:
+    sample (i, j) of any task has label i by construction (an iota).
+
+    ``augment`` is static (per-set: train-time Omniglot only, matching the
+    ``augment_stack`` gate) so the no-rotation programs pay nothing for the
+    switch machinery.
+    """
+    decode = make_decoder(cfg)
+    rotate = augment and "omniglot" in cfg.dataset_name
+    if rotate and cfg.image_height != cfg.image_width:
+        raise ValueError(
+            "on-device rot90 augmentation requires square images "
+            f"(got {cfg.image_height}x{cfg.image_width}): lax.switch needs "
+            "shape-stable rotation branches"
+        )
+    spc = cfg.num_samples_per_class
+
+    def expand(store, gather, rot_k):
+        imgs = store[gather]  # (tasks, n, spc+nts, h, w, c) uint8 gather
+        x = decode(imgs)
+        if rotate:
+            # per-(task, class) rotation of the (spc+nts, h, w, c) stack —
+            # the vectorized form of augment_stack's np.rot90(axes=(1, 2))
+            x = jax.vmap(jax.vmap(_rot_stack))(x, rot_k)
+        y = jax.lax.broadcasted_iota(jnp.int32, gather.shape, 1)
+        return x[:, :, :spc], y[:, :, :spc], x[:, :, spc:], y[:, :, spc:]
+
+    return expand

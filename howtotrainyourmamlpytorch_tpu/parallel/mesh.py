@@ -78,3 +78,19 @@ def replicate_state(mesh: Mesh, tree):
     """Replicate a pytree (params/opt state) across the mesh."""
     sharding = replicated(mesh)
     return jax.device_put(tree, sharding)
+
+
+def replicate_array(mesh: Mesh, a) -> jax.Array:
+    """Replicate one array to every device of the mesh.
+
+    Used for the resident uint8 image store (``data_placement='device'``):
+    every device gathers arbitrary rows for its own shard of the task axis,
+    so the store must be whole on each device — splitting its image axis
+    would turn each step's gather into a cross-device all-gather of the
+    very pixels residency exists to stop moving. The per-batch *index*
+    tensors are what shard over the task axis (``shard_batch`` /
+    ``shard_stacked_batch``, same helpers as the pixel path), and in
+    multi-host runs each host samples only its ``shard_id`` slice of every
+    global batch, exactly like the pixel loader.
+    """
+    return jax.device_put(a, replicated(mesh))

@@ -56,9 +56,11 @@ def main() -> None:
                 "BENCH_REMAT_POLICY": policy,
                 "BENCH_TIMED_STEPS": args.steps,
                 # sweeps rank TRAIN throughput; the epoch-boundary tail
-                # (eval compile + checkpoint write) would only slow every
-                # point without changing the ranking
+                # (eval compile + checkpoint write) and the input-pipeline
+                # tiers would only slow every point without changing the
+                # ranking
                 "BENCH_SKIP_EPOCH_BOUNDARY": "1",
+                "BENCH_SKIP_INPUT_PIPELINE": "1",
             }
             if args.batch:
                 ov["BENCH_BATCH_SIZE"] = args.batch

@@ -61,6 +61,18 @@ def test_bench_prints_parsable_json_line():
     assert eb["val_seconds"] > 0 and eb["ckpt_seconds"] > 0
     assert eb["ckpt_seconds"] >= eb["ckpt_blocking_seconds"]
     assert eb["val_batches"] >= 1 and eb["eval_batches_per_dispatch"] >= 1
+    # the three-tier input-pipeline measurement: uint8 streaming moves ~4x
+    # fewer H2D bytes (pixels exactly 4x; int32 labels unchanged), the
+    # index-only device tier well under 1 MB/step
+    ip = rec["input_pipeline"]
+    host_b = ip["host"]["h2d_bytes_per_step"]
+    u8_b = ip["uint8_stream"]["h2d_bytes_per_step"]
+    dev_b = ip["device"]["h2d_bytes_per_step"]
+    assert host_b >= 3.9 * u8_b
+    assert dev_b < 1_000_000 and dev_b < u8_b
+    for tier in ("host", "uint8_stream", "device"):
+        assert ip[tier]["assembly_ms_per_step"] >= 0
+        assert ip[tier]["producer_stall_ms_per_step"] >= 0
     assert rec["n_chips"] >= 1
     assert rec["dtype"] in ("float32", "bfloat16")
     # CPU has no published MXU peak -> mfu is null, never a bogus number

@@ -111,3 +111,29 @@ def test_classification_mean_std_from_json(tmp_path):
     np.testing.assert_allclose(
         out[..., 1], (0.5071 - 0.4866) / 0.2564, rtol=1e-5
     )
+
+
+def test_data_placement_validated():
+    """data_placement is checked at config time: bad values, CIFAR (per-image
+    RNG augmentation can't vectorize on device), and the missing flat-store
+    backing all fail with clear errors instead of a silent wrong-numbers
+    path."""
+    MAMLConfig(data_placement="host")  # default path needs nothing extra
+    MAMLConfig(data_placement="device", use_mmap_cache=True)
+    MAMLConfig(data_placement="uint8_stream", use_mmap_cache=True)
+    with pytest.raises(ValueError, match="data_placement"):
+        MAMLConfig(data_placement="hbm")
+    with pytest.raises(ValueError, match="CIFAR"):
+        MAMLConfig(
+            dataset_name="cifar_fs", data_placement="device",
+            use_mmap_cache=True,
+        )
+    with pytest.raises(ValueError, match="CIFAR"):
+        MAMLConfig(
+            dataset_name="cifar100", data_placement="uint8_stream",
+            use_mmap_cache=True,
+        )
+    with pytest.raises(ValueError, match="use_mmap_cache"):
+        MAMLConfig(data_placement="device")
+    with pytest.raises(ValueError, match="use_mmap_cache"):
+        MAMLConfig(data_placement="uint8_stream")

@@ -1,0 +1,422 @@
+"""Device-resident episode pipeline tests (data_placement tiers).
+
+Bit-exactness proofs: the on-device gather/decode/rot90 path
+(``ops.device_pipeline``) and the uint8-stream host gather must produce
+arrays IDENTICAL to the host float path — for Omniglot (unrescaled float
+cast + rot-k) and Mini-ImageNet (/255 + ImageNet-stat normalize, incl.
+reverse_channels) — and a full jitted train step over them must produce
+identical loss/accuracy. Plus the loader tiers end-to-end through the
+system facade, and the producer-thread leak fix.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.core import maml, msl
+from howtotrainyourmamlpytorch_tpu.data.episodes import (
+    sample_episode,
+    sample_episode_indices,
+)
+from howtotrainyourmamlpytorch_tpu.data.loader import (
+    IndexBatch,
+    MetaLearningDataLoader,
+)
+from howtotrainyourmamlpytorch_tpu.data.preprocess import FlatStore
+from howtotrainyourmamlpytorch_tpu.ops import device_pipeline
+
+
+def _flat_store(n_classes=6, per_class=9, h=8, w=8, c=1, seed=0):
+    rng = np.random.RandomState(seed)
+    data = rng.randint(0, 256, (n_classes * per_class, h, w, c), dtype=np.uint8)
+    return FlatStore(
+        data=data,
+        offsets={str(i): i * per_class for i in range(n_classes)},
+        sizes={str(i): per_class for i in range(n_classes)},
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset_name="omniglot_dataset",
+        image_height=8,
+        image_width=8,
+        image_channels=1,
+        num_classes_per_set=4,
+        num_samples_per_class=1,
+        num_target_samples=2,
+        use_mmap_cache=True,
+        data_placement="device",
+    )
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+def _expand_one(cfg, store, seed, augment):
+    """Host episode + its on-device indexed expansion, for comparison."""
+    views = store.views()
+    keys = np.array(list(views.keys()))
+    host = sample_episode(cfg, views, keys, seed=seed, augment=augment)
+    ie = sample_episode_indices(cfg, store.offsets, store.sizes, keys, seed=seed)
+    expand = jax.jit(device_pipeline.make_index_expander(cfg, augment=augment))
+    x_s, y_s, x_t, y_t = expand(store.data, ie.gather[None], ie.rot_k[None])
+    return host, (np.asarray(x_s[0]), np.asarray(y_s[0]),
+                  np.asarray(x_t[0]), np.asarray(y_t[0]))
+
+
+@pytest.mark.parametrize("augment", [False, True])
+def test_indexed_path_bit_exact_omniglot(augment):
+    """Omniglot: unrescaled float cast + per-class rot-k, bit-for-bit."""
+    cfg = _cfg()
+    store = _flat_store()
+    host, (x_s, y_s, x_t, y_t) = _expand_one(cfg, store, seed=7, augment=augment)
+    np.testing.assert_array_equal(x_s, host.x_support)
+    np.testing.assert_array_equal(x_t, host.x_target)
+    np.testing.assert_array_equal(y_s, host.y_support)
+    np.testing.assert_array_equal(y_t, host.y_target)
+
+
+@pytest.mark.parametrize("reverse_channels", [False, True])
+def test_indexed_path_bit_exact_mini_imagenet(reverse_channels):
+    """Mini-ImageNet: /255 + ImageNet-stat normalize (+ BGR flip),
+    bit-for-bit — the decode LUT makes the device values the host values by
+    construction (XLA fast-math would otherwise drift ULPs)."""
+    cfg = _cfg(
+        dataset_name="mini_imagenet",
+        image_channels=3,
+        num_samples_per_class=2,
+        reverse_channels=reverse_channels,
+    )
+    store = _flat_store(c=3, seed=1)
+    host, (x_s, _, x_t, _) = _expand_one(cfg, store, seed=3, augment=False)
+    np.testing.assert_array_equal(x_s, host.x_support)
+    np.testing.assert_array_equal(x_t, host.x_target)
+
+
+def test_uint8_stream_decode_bit_exact():
+    """uint8 host gather + on-device decode == host float path, bit-for-bit
+    (rot90 on integer pixels commutes with the elementwise decode)."""
+    cfg = _cfg(dataset_name="mini_imagenet", image_channels=3,
+               data_placement="uint8_stream")
+    store = _flat_store(c=3, seed=5)
+    views = store.views()
+    keys = np.array(list(views.keys()))
+    host = sample_episode(cfg, views, keys, seed=9, augment=False)
+    ie = sample_episode_indices(cfg, store.offsets, store.sizes, keys, seed=9)
+    x_u8 = store.data[ie.gather]
+    decode = jax.jit(device_pipeline.make_decoder(cfg))
+    x = np.asarray(decode(x_u8))
+    spc = cfg.num_samples_per_class
+    np.testing.assert_array_equal(x[:, :spc], host.x_support)
+    np.testing.assert_array_equal(x[:, spc:], host.x_target)
+
+
+def test_index_rng_parity_with_pixel_path():
+    """The four-draw RNG discipline: the rows the index sampler selects are
+    exactly the images the pixel sampler decodes (pre-decode gather)."""
+    cfg = _cfg()
+    store = _flat_store()
+    views = store.views()
+    keys = np.array(list(views.keys()))
+    host = sample_episode(cfg, views, keys, seed=11, augment=False)
+    ie = sample_episode_indices(cfg, store.offsets, store.sizes, keys, seed=11)
+    gathered = store.data[ie.gather].astype(np.float32)  # omniglot decode
+    spc = cfg.num_samples_per_class
+    np.testing.assert_array_equal(gathered[:, :spc], host.x_support)
+    np.testing.assert_array_equal(gathered[:, spc:], host.x_target)
+
+
+def test_train_step_identical_across_batch_forms():
+    """A full jitted train step fed (a) host pixels and (b) store+indices
+    produces identical loss/accuracy — the whole-program equivalence the
+    placement tiers rely on."""
+    cfg = _cfg(
+        num_samples_per_class=2,
+        cnn_num_filters=3,
+        num_stages=1,
+        number_of_training_steps_per_iter=2,
+        use_remat=False,
+    )
+    store = _flat_store(h=8, w=8)
+    views = store.views()
+    keys = np.array(list(views.keys()))
+    eps, ies = [], []
+    for seed in (3, 4):
+        eps.append(sample_episode(cfg, views, keys, seed=seed, augment=True))
+        ies.append(
+            sample_episode_indices(cfg, store.offsets, store.sizes, keys, seed=seed)
+        )
+    x_s = np.stack([e.x_support for e in eps])
+    x_t = np.stack([e.x_target for e in eps])
+    y_s = np.stack([e.y_support for e in eps])
+    y_t = np.stack([e.y_target for e in eps])
+    gather = np.stack([ie.gather for ie in ies])
+    rot_k = np.stack([ie.rot_k for ie in ies])
+    weights = np.asarray(msl.final_step_only(
+        cfg.number_of_training_steps_per_iter))
+
+    state = maml.init_state(cfg)
+    pixel_step = jax.jit(maml.make_train_step(cfg, second_order=True))
+    state_p, metrics_p = pixel_step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
+
+    state2 = maml.init_state(cfg)
+    idx_step = jax.jit(
+        maml.make_train_step_indexed(cfg, second_order=True, augment=True)
+    )
+    state_i, metrics_i = idx_step(state2, store.data, gather, rot_k, weights, 1e-3)
+
+    np.testing.assert_allclose(
+        np.asarray(metrics_p["loss"]), np.asarray(metrics_i["loss"]),
+        rtol=0, atol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(metrics_p["accuracy"]), np.asarray(metrics_i["accuracy"]),
+        rtol=0, atol=0,
+    )
+    for k in state_p.net:
+        np.testing.assert_array_equal(
+            np.asarray(state_p.net[k]), np.asarray(state_i.net[k])
+        )
+
+
+def test_non_square_rot90_rejected():
+    cfg = _cfg(image_height=8, image_width=6)
+    with pytest.raises(ValueError, match="square"):
+        device_pipeline.make_index_expander(cfg, augment=True)
+    # rotation not traced in -> no constraint
+    device_pipeline.make_index_expander(cfg, augment=False)
+
+
+# -- loader tiers on a real (synthetic) on-disk dataset ---------------------
+
+
+def _write_presplit(root, mode, n_classes=4, per_class=5, size=12, seed=0):
+    rng = np.random.RandomState(seed)
+    for set_name in ("train", "val", "test"):
+        for ci in range(n_classes):
+            d = os.path.join(root, set_name, f"c{ci:02d}")
+            os.makedirs(d, exist_ok=True)
+            base = rng.randint(0, 200)
+            shape = (size, size) if mode == "L" else (size, size, 3)
+            for j in range(per_class):
+                arr = np.clip(
+                    base + rng.randint(-30, 30, shape), 0, 255
+                ).astype(np.uint8)
+                Image.fromarray(arr, mode).save(os.path.join(d, f"im{j}.png"))
+
+
+def _tier_cfg(root, cache, placement, dataset_name, channels):
+    return MAMLConfig(
+        dataset_name=dataset_name,
+        dataset_path=root,
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=12, image_width=12, image_channels=channels,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=2,
+        batch_size=2, cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_mmap_cache=True, use_remat=False, seed=0,
+        num_dataprovider_workers=2, cache_dir=cache,
+        data_placement=placement,
+    )
+
+
+@pytest.mark.parametrize(
+    "dataset_name,mode,channels",
+    [("omniglot_synth", "L", 1), ("mini_imagenet_synth", "RGB", 3)],
+)
+def test_loader_tiers_bit_exact(tmp_path, dataset_name, mode, channels):
+    """Equivalence at the loader level: for a fixed seed, the uint8 tier's
+    device-decoded batches and the device tier's expanded index batches are
+    bit-identical to the host tier's float batches."""
+    root = str(tmp_path / dataset_name)
+    _write_presplit(root, mode)
+    batches = {}
+    for placement in ("host", "uint8_stream", "device"):
+        cache = str(tmp_path / f"cache_{placement}")
+        cfg = _tier_cfg(root, cache, placement, dataset_name, channels)
+        loader = MetaLearningDataLoader(
+            cfg, cache_dir=cache, shard_id=0, num_shards=1
+        )
+        batches[placement] = (
+            cfg,
+            loader,
+            list(loader.get_train_batches(total_batches=2, augment_images=True)),
+        )
+
+    cfg_h, _, host = batches["host"]
+    _, _, u8 = batches["uint8_stream"]
+    cfg_d, loader_d, dev = batches["device"]
+    decode = jax.jit(device_pipeline.make_decoder(cfg_h))
+    augment = "omniglot" in dataset_name
+    expand = jax.jit(
+        device_pipeline.make_index_expander(cfg_d, augment=augment)
+    )
+    store = loader_d.dataset.flat_stores["train"].data
+    for hb, ub, db in zip(host, u8, dev):
+        assert isinstance(db, IndexBatch) and db.set_name == "train"
+        assert ub[0].dtype == np.uint8
+        # uint8 tier: device decode reproduces the host floats
+        np.testing.assert_array_equal(np.asarray(decode(ub[0])), hb[0])
+        np.testing.assert_array_equal(np.asarray(decode(ub[1])), hb[1])
+        np.testing.assert_array_equal(ub[2], hb[2])  # labels
+        np.testing.assert_array_equal(ub[4], hb[4])  # seeds
+        # device tier: index expansion reproduces the host floats
+        x_s, y_s, x_t, y_t = expand(store, db.gather, db.rot_k)
+        np.testing.assert_array_equal(np.asarray(x_s), hb[0])
+        np.testing.assert_array_equal(np.asarray(x_t), hb[1])
+        np.testing.assert_array_equal(np.asarray(y_s), hb[2])
+        np.testing.assert_array_equal(np.asarray(y_t), hb[3])
+        np.testing.assert_array_equal(db.seeds, hb[4])
+
+
+@pytest.mark.slow
+def test_system_tiers_identical_through_full_steps(tmp_path):
+    """Acceptance equivalence: for a fixed seed, the 'device' and
+    'uint8_stream' placements reproduce the host path's per-step train
+    loss/accuracy (and fused-dispatch + validation metrics) through the full
+    system facade."""
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    root = str(tmp_path / "omniglot_synth")
+    _write_presplit(root, "L")
+    results = {}
+    for placement in ("host", "uint8_stream", "device"):
+        cache = str(tmp_path / f"cache_{placement}")
+        cfg = _tier_cfg(root, cache, placement, "omniglot_synth", 1)
+        model = MAMLFewShotClassifier(cfg, use_mesh=False)
+        loader = MetaLearningDataLoader(
+            cfg, cache_dir=cache, shard_id=0, num_shards=1
+        )
+        if placement == "device":
+            model.register_flat_stores(
+                {n: fs.data for n, fs in loader.dataset.flat_stores.items()}
+            )
+        vals = []
+        for b in loader.get_train_batches(total_batches=2, augment_images=True):
+            m = model.run_train_iter(b, epoch=0)
+            vals += [float(np.asarray(m["loss"])),
+                     float(np.asarray(m["accuracy"]))]
+        chunk = list(loader.get_train_batches(total_batches=2,
+                                              augment_images=True))
+        mm = model.run_train_iters(chunk, epoch=0)
+        vals += np.asarray(mm["loss"]).ravel().tolist()
+        vb = list(loader.get_val_batches(total_batches=2))
+        vm, preds = model.run_validation_iters(vb, return_preds=True)
+        vals += np.asarray(vm["loss"]).ravel().tolist()
+        results[placement] = (np.asarray(vals), np.asarray(preds))
+
+    np.testing.assert_array_equal(
+        results["host"][0], results["uint8_stream"][0]
+    )
+    np.testing.assert_array_equal(results["host"][0], results["device"][0])
+    np.testing.assert_array_equal(
+        results["host"][1], results["uint8_stream"][1]
+    )
+    np.testing.assert_array_equal(results["host"][1], results["device"][1])
+
+
+@pytest.mark.slow
+def test_device_tier_on_mesh_matches_single_device(tmp_path):
+    """data_placement='device' on a multi-device mesh: the store replicates,
+    the index batches shard over the task axis, and metrics equal the
+    unsharded run (the sharded gather reads the same replicated rows)."""
+    import jax as _jax
+
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    if len(_jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device CPU backend")
+    root = str(tmp_path / "omniglot_synth")
+    _write_presplit(root, "L")
+    out = {}
+    for use_mesh in (False, True):
+        cache = str(tmp_path / f"cache_{use_mesh}")
+        cfg = _tier_cfg(root, cache, "device", "omniglot_synth", 1)
+        model = MAMLFewShotClassifier(cfg, use_mesh=use_mesh)
+        if use_mesh:
+            assert model.mesh is not None
+        loader = MetaLearningDataLoader(
+            cfg, cache_dir=cache, shard_id=0, num_shards=1
+        )
+        model.register_flat_stores(
+            {n: fs.data for n, fs in loader.dataset.flat_stores.items()}
+        )
+        vals = []
+        for b in loader.get_train_batches(total_batches=2, augment_images=True):
+            m = model.run_train_iter(b, epoch=0)
+            vals.append(float(np.asarray(m["loss"])))
+        vb = list(loader.get_val_batches(total_batches=1))
+        vm, _ = model.run_validation_iter(vb[0])
+        vals.append(float(np.asarray(vm["loss"])))
+        out[use_mesh] = np.asarray(vals)
+    np.testing.assert_allclose(out[False], out[True], rtol=1e-6)
+
+
+def test_device_tier_index_batches_are_tiny(tmp_path):
+    """The H2D contract: an IndexBatch is a few KB where the float batch is
+    MBs (the whole point of the tier)."""
+    root = str(tmp_path / "omniglot_synth")
+    _write_presplit(root, "L")
+    cache = str(tmp_path / "cache")
+    cfg = _tier_cfg(root, cache, "device", "omniglot_synth", 1)
+    loader = MetaLearningDataLoader(cfg, cache_dir=cache, shard_id=0, num_shards=1)
+    (b,) = list(loader.get_train_batches(total_batches=1))
+    index_bytes = b.gather.nbytes + b.rot_k.nbytes
+    cfg_h = cfg.replace(data_placement="host")
+    loader_h = MetaLearningDataLoader(
+        cfg_h, cache_dir=str(tmp_path / "cache_h"), shard_id=0, num_shards=1
+    )
+    (hb,) = list(loader_h.get_train_batches(total_batches=1))
+    pixel_bytes = sum(int(a.nbytes) for a in hb[:4])
+    assert index_bytes * 50 < pixel_bytes  # 12x12x1 floats vs int32 indices
+
+
+def test_producer_thread_exits_when_consumer_abandons(tmp_path):
+    """Satellite: a producer blocked in put() against a full queue must
+    observe stop and exit when the consumer abandons the generator (the old
+    blocking put leaked the thread forever)."""
+    root = str(tmp_path / "omniglot_synth")
+    _write_presplit(root, "L")
+    cache = str(tmp_path / "cache")
+    cfg = _tier_cfg(root, cache, "host", "omniglot_synth", 1).replace(
+        prefetch_batches=1
+    )
+    loader = MetaLearningDataLoader(cfg, cache_dir=cache, shard_id=0, num_shards=1)
+    gen = loader.get_train_batches(total_batches=100)
+    next(gen)  # start the stream; producer races ahead and fills the queue
+    thread = loader._last_producer_thread
+    assert thread is not None and thread.is_alive()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:  # wait for it to park in put()
+        time.sleep(0.05)
+        if loader.pop_stream_stats()["batches"] >= 1:
+            break
+    gen.close()  # consumer abandons -> finally: stop.set()
+    thread.join(10.0)
+    assert not thread.is_alive(), "producer thread leaked after consumer close"
+
+
+def test_stream_stats_accumulate_and_reset(tmp_path):
+    root = str(tmp_path / "omniglot_synth")
+    _write_presplit(root, "L")
+    cache = str(tmp_path / "cache")
+    cfg = _tier_cfg(root, cache, "host", "omniglot_synth", 1)
+    loader = MetaLearningDataLoader(cfg, cache_dir=cache, shard_id=0, num_shards=1)
+    list(loader.get_train_batches(total_batches=3))
+    stats = loader.pop_stream_stats()
+    assert stats["batches"] == 3
+    assert stats["assembly_s"] > 0.0
+    assert stats["stall_s"] >= 0.0
+    assert loader.pop_stream_stats()["batches"] == 0  # reset

@@ -172,3 +172,69 @@ def test_max_models_to_save_prunes_checkpoints(tmp_path):
     before = set(os.listdir(builder.saved_models_filepath))
     builder._prune_saved_models()
     assert set(os.listdir(builder.saved_models_filepath)) == before
+
+
+@pytest.mark.slow
+def test_presplit_uint8_stream_end_to_end(tmp_path):
+    """The uint8_stream placement tier end-to-end on the presplit config:
+    host ships raw uint8, the jitted step decodes on device. Exercises the
+    chunked train dispatch, fused eval, checkpoints, resume-free full run —
+    and asserts the metrics equal a host-placement run bit-for-bit (the
+    on-device decode LUT is the host decode by construction)."""
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root))
+
+    def run(placement, name):
+        cfg = MAMLConfig(
+            experiment_name=str(tmp_path / name),
+            dataset_name="mini_imagenet_full_size",
+            dataset_path=str(data_root),
+            sets_are_pre_split=True,
+            indexes_of_folders_indicating_class=[-3, -2],
+            image_height=10, image_width=10, image_channels=3,
+            num_classes_per_set=2, num_samples_per_class=1,
+            num_target_samples=1,
+            batch_size=2, cnn_num_filters=4, num_stages=2, max_pooling=True,
+            per_step_bn_statistics=True,
+            learnable_per_layer_per_step_inner_loop_learning_rate=True,
+            use_multi_step_loss_optimization=True, second_order=True,
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+            total_epochs=2, total_iter_per_epoch=2, num_evaluation_tasks=4,
+            total_epochs_before_pause=100,
+            num_dataprovider_workers=2,
+            cache_dir=str(tmp_path / f"cache_{name}"),
+            use_mmap_cache=True, use_remat=False, seed=0,
+            steps_per_dispatch=2,
+            eval_batches_per_dispatch=2,
+            data_placement=placement,
+        )
+        model = MAMLFewShotClassifier(cfg, use_mesh=False)
+        builder = ExperimentBuilder(
+            cfg, model, MetaLearningDataLoader,
+            experiment_root=str(tmp_path), verbose=False,
+        )
+        test_losses = builder.run_experiment()
+        return builder, test_losses
+
+    builder_u8, test_u8 = run("uint8_stream", "exp_u8")
+    assert 0.0 <= test_u8["test_accuracy_mean"] <= 1.0
+    saved = os.listdir(builder_u8.saved_models_filepath)
+    assert "train_model_latest" in saved and "train_model_1" in saved
+    logs = os.listdir(builder_u8.logs_filepath)
+    assert "summary_statistics.csv" in logs and "test_summary.csv" in logs
+
+    builder_host, test_host = run("host", "exp_host")
+    assert test_u8 == test_host
+    import csv
+
+    def rows(builder):
+        with open(os.path.join(
+            builder.logs_filepath, "summary_statistics.csv"
+        )) as f:
+            return [
+                (r["train_loss_mean"], r["val_accuracy_mean"])
+                for r in csv.DictReader(f)
+            ]
+
+    assert rows(builder_u8) == rows(builder_host)
