@@ -406,6 +406,62 @@ def _measure_input_pipeline(cfg, reduced: bool) -> dict | None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _measure_telemetry_overhead(
+    cfg, mesh, batch, weights, off_ms_per_step: float, reduced: bool
+) -> dict | None:
+    """Step-time cost of the on-device training-dynamics collection
+    (``telemetry_level='dynamics'`` vs. off), so the telemetry trajectory
+    is tracked in the bench line like ``epoch_boundary``.
+
+    The 'off' arm IS the main timed loop (the flagship step is built with
+    telemetry off); only the dynamics arm is compiled and timed here, with
+    the same sync protocol. Informational — never part of baseline
+    comparability. Best-effort: any failure returns None with a stderr
+    note rather than killing the bench line.
+    """
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+
+    steps_n = int(
+        os.environ.get("BENCH_TELEMETRY_STEPS", "2" if reduced else "10")
+    )
+    try:
+        tcfg = cfg.replace(telemetry_level="dynamics")
+        state = maml.init_state(tcfg)
+        if mesh is not None:
+            from howtotrainyourmamlpytorch_tpu.parallel import mesh as mesh_lib
+
+            state = mesh_lib.replicate_state(mesh, state)
+        step = jax.jit(
+            maml.make_train_step(tcfg, second_order=True), donate_argnums=(0,)
+        )
+        x_s, y_s, x_t, y_t = batch
+        state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)  # compile
+        jax.block_until_ready(state.net)
+        float(np.asarray(m["loss"]))
+        start = time.perf_counter()
+        for _ in range(steps_n):
+            state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
+        jax.block_until_ready(state.net)
+        float(np.asarray(m["loss"]))  # tunnel-proof sync (see sync())
+        dyn_ms = (time.perf_counter() - start) / steps_n * 1e3
+        return {
+            "off_ms_per_step": round(off_ms_per_step, 3),
+            "dynamics_ms_per_step": round(dyn_ms, 3),
+            "overhead_pct": (
+                round((dyn_ms - off_ms_per_step) / off_ms_per_step * 100, 2)
+                if off_ms_per_step > 0
+                else None
+            ),
+            "timed_steps": steps_n,
+        }
+    except Exception as e:  # noqa: BLE001 - informational metric only
+        print(f"bench: telemetry_overhead measurement failed ({e!r})",
+              file=sys.stderr)
+        return None
+
+
 # BENCH_* env vars that change WHAT is measured (workload shapes or
 # lowering); a run with any of these set must never refresh the baseline
 _WORKLOAD_KNOBS = (
@@ -492,6 +548,7 @@ def main() -> None:
         )
     )
     sharded = n_chips > 1 and cfg.batch_size % n_chips == 0
+    mesh = None
     if sharded:
         # shard the task axis so every chip actually works; tasks/s/chip is
         # then global throughput / chips
@@ -564,6 +621,15 @@ def main() -> None:
     if os.environ.get("BENCH_SKIP_INPUT_PIPELINE") != "1":
         input_pipeline = _measure_input_pipeline(cfg, reduced)
 
+    # on-device dynamics collection cost (telemetry_level='dynamics' vs
+    # off): null when skipped or unmeasurable
+    telemetry_overhead = None
+    if os.environ.get("BENCH_SKIP_TELEMETRY_OVERHEAD") != "1":
+        telemetry_overhead = _measure_telemetry_overhead(
+            cfg, mesh, (x_s, y_s, x_t, y_t), weights,
+            elapsed / timed_steps * 1e3, reduced,
+        )
+
     peak = _peak_flops(device_kind, cfg.compute_dtype)
     # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
     # recompute) over peak. hfu: *executed* FLOPs per XLA's cost analysis of
@@ -624,6 +690,9 @@ def main() -> None:
         # per-tier H2D bytes/step + host assembly/stall ms (informational —
         # not part of baseline comparability)
         "input_pipeline": input_pipeline,
+        # step time with telemetry_level='dynamics' vs off (informational —
+        # not part of baseline comparability)
+        "telemetry_overhead": telemetry_overhead,
         # pinned workload descriptor: makes round-over-round lines
         # self-describing so a knob-default change can never silently turn
         # the driver series into an apples-to-oranges trend
@@ -679,7 +748,7 @@ def main() -> None:
             k: v for k, v in result.items()
             if k not in ("vs_baseline", "baseline_backend",
                          "baseline_refreshed", "epoch_boundary",
-                         "input_pipeline")
+                         "input_pipeline", "telemetry_overhead")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

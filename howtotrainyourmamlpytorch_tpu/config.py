@@ -184,6 +184,38 @@ class MAMLConfig:
     eval_batches_per_dispatch: int = 1
     profile_trace_dir: str = ""  # jax profiler trace output ('' => disabled)
     profile_num_steps: int = 5  # train iterations captured in the trace
+    # trace-window scheduling (telemetry ISSUE 3): capture train iterations
+    # [profile_start_step, profile_start_step + profile_num_steps) of epoch
+    # `profile_epoch` without code edits. profile_epoch=-1 keeps the legacy
+    # behaviour (first steps of THIS run, whatever epoch resume landed on);
+    # >= 0 targets that global epoch, 0-BASED like every other epoch-valued
+    # knob here (first_order_to_second_order_epoch, the LR/MSL schedules).
+    # NB the CSV/telemetry `epoch` labels are 1-based at write time, so to
+    # trace the epoch recorded as epoch N pass profile_epoch = N - 1.
+    # start_step defaults past iteration 0 so the compile step never
+    # pollutes the trace.
+    profile_epoch: int = -1
+    profile_start_step: int = 1
+    # --- observability (telemetry/) --------------------------------------
+    # 'off'      — reference-style reporting only (CSV + tqdm), zero overhead
+    #              and bit-identical metrics;
+    # 'scalars'  — schema-versioned JSONL event log (logs/telemetry.jsonl):
+    #              epoch scalars, dispatch timings, loader stream stats,
+    #              checkpoint events, device memory, watchdog diagnostics —
+    #              host-side only, the device programs are untouched;
+    # 'dynamics' — additionally collect MAML++'s training dynamics ON DEVICE
+    #              inside the fused train dispatches (per-inner-step support/
+    #              target losses, per-layer inner-grad norms, the learned
+    #              LSLR vectors, the MSL weight vector), stacked in the
+    #              existing lax.scan so collection adds zero extra device
+    #              syncs; flushed to the JSONL log at epoch-summary time.
+    telemetry_level: str = "off"
+    telemetry_tensorboard: bool = False  # mirror epoch scalars to TensorBoard
+    # heartbeat hang watchdog: when > 0, a daemon thread dumps a diagnostic
+    # JSONL record + all-thread stack snapshot if the train/eval/checkpoint
+    # loop reports no progress for this many seconds (multihost hang
+    # debugging: the stack names the blocking collective). 0 disables.
+    watchdog_timeout_s: float = 0.0
     # persistent XLA compilation cache: resumed runs skip the 20-40s TPU
     # compile of the train/eval steps ('' => disabled)
     compilation_cache_dir: str = ""
@@ -287,6 +319,21 @@ class MAMLConfig:
                     "from the flat uint8 image store that only the mmap "
                     "cache builds (data/preprocess.py)"
                 )
+        if self.telemetry_level not in ("off", "scalars", "dynamics"):
+            raise ValueError(
+                f"telemetry_level must be 'off', 'scalars' or 'dynamics', "
+                f"got {self.telemetry_level!r}"
+            )
+        if self.watchdog_timeout_s < 0:
+            raise ValueError(
+                f"watchdog_timeout_s must be >= 0 (0 disables), got "
+                f"{self.watchdog_timeout_s}"
+            )
+        if self.profile_start_step < 0:
+            raise ValueError(
+                f"profile_start_step must be >= 0, got "
+                f"{self.profile_start_step}"
+            )
         if self.remat_policy not in ("full", "save_conv"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'save_conv', got "

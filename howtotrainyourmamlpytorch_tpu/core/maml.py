@@ -121,11 +121,20 @@ def make_optimizer(cfg: MAMLConfig, params: Dict[str, jnp.ndarray]):
     )
 
 
-def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
+def _task_learner(
+    cfg: MAMLConfig, num_steps: int, second_order: bool, collect: bool = False
+):
     """Per-task bi-level loss: the reference's per-task body
     (few_shot_learning_system.py:197-252) as a pure function.
 
-    Returns (task_loss, (per_sample_correct, new_bn_state, final_softmax)).
+    Returns (task_loss, (per_sample_correct, new_bn_state, final_softmax,
+    dynamics)). ``collect`` (``telemetry_level='dynamics'``) additionally
+    stacks per-inner-step support/target losses and per-layer inner-grad
+    L2 norms into ``dynamics`` — computed inside the existing scan from
+    values the step already has (the support gradient is reused, the loss
+    value rides along via value_and_grad), all under ``stop_gradient`` so
+    the meta-gradient graph is untouched; ``collect=False`` traces the
+    exact pre-telemetry program (``dynamics`` is then an empty pytree).
     """
 
     def inner_step(frozen, lslr_params, x_s, y_s, x_t, y_t, carry, step):
@@ -137,7 +146,23 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
             )
             return F.cross_entropy(logits, y_s), new_bn
 
-        grads, new_bn = jax.grad(support_loss_fn, has_aux=True)(theta)
+        if collect:
+            (s_loss, new_bn), grads = jax.value_and_grad(
+                support_loss_fn, has_aux=True
+            )(theta)
+        else:
+            grads, new_bn = jax.grad(support_loss_fn, has_aux=True)(theta)
+        extras = {}
+        if collect:
+            extras = {
+                "support_losses": jax.lax.stop_gradient(s_loss),
+                "grad_norms": {
+                    k: jax.lax.stop_gradient(
+                        jnp.sqrt(jnp.sum(jnp.square(g))).astype(jnp.float32)
+                    )
+                    for k, g in grads.items()
+                },
+            }
         if not second_order:
             # first-order MAML: cut the graph through the inner gradient
             # (ref: create_graph=False, few_shot_learning_system.py:138)
@@ -153,7 +178,7 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
             cfg, {**frozen, **theta}, new_bn, x_t, step, training=True
         )
         t_loss = F.cross_entropy(t_logits, y_t)
-        return (theta, new_bn), (t_loss, t_logits)
+        return (theta, new_bn), (t_loss, t_logits, extras)
 
     def task_loss(net, lslr_params, bn_state, x_s, y_s, x_t, y_t, loss_weights):
         # flatten (n, s, h, w, c) sets to (n*s, h, w, c)
@@ -182,7 +207,7 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
         # XLA can fuse instead of dynamic-update-slice machinery — a large
         # constant-factor win on CPU, neutral-to-positive on TPU (compile
         # time stays bounded because num_steps is small)
-        (theta_f, bn_f), (t_losses, t_logits) = jax.lax.scan(
+        (theta_f, bn_f), (t_losses, t_logits, extras) = jax.lax.scan(
             step_fn,
             (adapted, bn_state),
             jnp.arange(num_steps),
@@ -191,7 +216,16 @@ def _task_learner(cfg: MAMLConfig, num_steps: int, second_order: bool):
         loss = jnp.dot(loss_weights.astype(t_losses.dtype), t_losses)
         final_logits = t_logits[-1]
         correct = F.accuracy(final_logits, y_t)
-        return loss, (correct, bn_f, jax.nn.softmax(final_logits, axis=-1))
+        dynamics = {}
+        if collect:
+            # (num_steps,) stacks per key; target losses are the MSL inputs
+            dynamics = {
+                **extras,
+                "target_losses": jax.lax.stop_gradient(t_losses),
+            }
+        return loss, (
+            correct, bn_f, jax.nn.softmax(final_logits, axis=-1), dynamics
+        )
 
     return task_loss
 
@@ -228,7 +262,7 @@ def _meta_loss_and_grads(
     """Outer loss + meta-gradients over the task batch."""
 
     def outer_loss(trainable):
-        losses, (correct, bns, _) = _map_tasks(
+        losses, (correct, bns, _, dyn) = _map_tasks(
             lambda xs, ys, xt, yt: learner(
                 trainable["net"], trainable["lslr"], state.bn,
                 xs, ys, xt, yt, loss_weights,
@@ -236,13 +270,13 @@ def _meta_loss_and_grads(
             task_mode, x_s, y_s, x_t, y_t,
         )
         # mean over tasks (few_shot_learning_system.py:164)
-        return jnp.mean(losses), (correct, bns)
+        return jnp.mean(losses), (correct, bns, dyn)
 
     trainable = {"net": state.net, "lslr": state.lslr}
-    (loss, (correct, bns)), grads = jax.value_and_grad(
+    (loss, (correct, bns, dyn)), grads = jax.value_and_grad(
         outer_loss, has_aux=True
     )(trainable)
-    return trainable, loss, correct, bns, grads
+    return trainable, loss, correct, bns, grads, dyn
 
 
 def make_grads_fn(cfg: MAMLConfig, second_order: bool):
@@ -259,7 +293,7 @@ def make_grads_fn(cfg: MAMLConfig, second_order: bool):
     )
 
     def grads_fn(state: MetaState, x_s, y_s, x_t, y_t, loss_weights):
-        _, loss, _, _, grads = _meta_loss_and_grads(
+        _, loss, _, _, grads, _ = _meta_loss_and_grads(
             learner, state, x_s, y_s, x_t, y_t, loss_weights,
             cfg.task_axis_mode,
         )
@@ -287,9 +321,17 @@ def make_train_step(
     uint8 (host gathered + rotated, decode deferred) and the step decodes
     them on device as a prelude; ``decode_uint8`` overrides the gate (the
     indexed path decodes inside its own expander).
+
+    ``telemetry_level='dynamics'`` adds a ``metrics['dynamics']`` dict to
+    the output — per-inner-step support/target losses and per-layer
+    inner-grad norms (task-mean, stacked ``(num_steps,)`` inside the
+    existing scan), the post-update LSLR vectors, and the MSL weight
+    vector. It rides back with the metrics, so collection adds zero extra
+    device syncs; with telemetry off the traced program is unchanged.
     """
     num_steps = cfg.number_of_training_steps_per_iter
-    learner = _task_learner(cfg, num_steps, second_order)
+    collect = cfg.telemetry_level == "dynamics"
+    learner = _task_learner(cfg, num_steps, second_order, collect)
     decode = _decode_prelude(cfg, decode_uint8)
 
     def train_step(state: MetaState, x_s, y_s, x_t, y_t, loss_weights, lr):
@@ -308,7 +350,7 @@ def make_train_step(
         # labels depend only on (static) key names, so building the transform
         # inside the traced function is free
         opt = make_optimizer(cfg, state.net)
-        trainable, loss, correct, bns, grads = _meta_loss_and_grads(
+        trainable, loss, correct, bns, grads, dyn = _meta_loss_and_grads(
             learner, state, x_s, y_s, x_t, y_t, loss_weights,
             cfg.task_axis_mode,
         )
@@ -331,6 +373,15 @@ def make_train_step(
             opt=new_opt,
         )
         metrics = {"loss": loss, "accuracy": jnp.mean(correct)}
+        if collect:
+            # mean over the (leading) task axis keeps the payload tiny:
+            # a handful of (num_steps,) vectors per dispatch
+            dynamics = jax.tree_util.tree_map(
+                lambda v: jnp.mean(v, axis=0), dyn
+            )
+            dynamics["lslr"] = new_trainable["lslr"]  # the learned LSLR
+            dynamics["msl_weights"] = jnp.asarray(loss_weights)
+            metrics["dynamics"] = dynamics
         return new_state, metrics
 
     return train_step
@@ -423,7 +474,7 @@ def make_eval_step(cfg: MAMLConfig, decode_uint8: Optional[bool] = None):
         if decode is not None:
             x_s, x_t = decode(x_s), decode(x_t)
         with jax.default_matmul_precision(cfg.resolved_matmul_precision):
-            losses, (correct, _, preds) = _map_tasks(
+            losses, (correct, _, preds, _) = _map_tasks(
                 lambda xs, ys, xt, yt: learner(
                     state.net, state.lslr, state.bn, xs, ys, xt, yt,
                     loss_weights

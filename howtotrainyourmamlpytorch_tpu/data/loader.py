@@ -206,12 +206,17 @@ class MetaLearningDataLoader:
         self.tasks_per_shard = self.tasks_per_batch // self.num_shards
         self.dataset = FewShotEpisodicDataset(cfg, cache_dir)
         self.total_train_iters_produced = 0
-        # input-pipeline telemetry (bench.py `input_pipeline`): cumulative
-        # episode-assembly seconds, producer-queue stall seconds (time the
-        # producer sat blocked in put() against a full queue), batches
+        # input-pipeline telemetry (bench.py `input_pipeline` + the per-epoch
+        # telemetry `stream` records): cumulative episode-assembly seconds,
+        # producer-queue stall seconds (time the producer sat blocked in
+        # put() against a full queue), post-put queue depth sum (mean depth
+        # ~= prefetch headroom: near-full means the producer outruns the
+        # consumer, near-empty means the device is starved), batches
         # produced. Guarded by a lock: train and val producers can overlap.
         self._stats_lock = threading.Lock()
-        self.stream_stats = {"assembly_s": 0.0, "stall_s": 0.0, "batches": 0}
+        self.stream_stats = {
+            "assembly_s": 0.0, "stall_s": 0.0, "depth_sum": 0.0, "batches": 0,
+        }
         self._last_producer_thread: Optional[threading.Thread] = None
         self.continue_from_iter(current_iter)
 
@@ -219,7 +224,10 @@ class MetaLearningDataLoader:
         """Return and reset the cumulative producer telemetry."""
         with self._stats_lock:
             out = dict(self.stream_stats)
-            self.stream_stats = {"assembly_s": 0.0, "stall_s": 0.0, "batches": 0}
+            self.stream_stats = {
+                "assembly_s": 0.0, "stall_s": 0.0, "depth_sum": 0.0,
+                "batches": 0,
+            }
         return out
 
     def continue_from_iter(self, current_iter: int) -> None:
@@ -297,6 +305,7 @@ class MetaLearningDataLoader:
                         with self._stats_lock:
                             self.stream_stats["assembly_s"] += t1 - t0
                             self.stream_stats["stall_s"] += t2 - t1
+                            self.stream_stats["depth_sum"] += out.qsize()
                             self.stream_stats["batches"] += 1
                 put(None)
             except BaseException as exc:  # surface worker errors to consumer

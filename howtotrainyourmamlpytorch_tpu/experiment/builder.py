@@ -30,7 +30,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..config import MAMLConfig
-from ..utils.profiling import StepTimer
+from ..telemetry import Telemetry, Watchdog
+from ..utils.profiling import StepTimer, TraceWindow
 from ..utils.storage import (
     build_experiment_folder,
     save_statistics,
@@ -62,6 +63,11 @@ class ExperimentBuilder:
         self.state: Dict = {"best_val_acc": 0.0, "best_val_iter": 0, "current_iter": 0}
         self.start_epoch = 0
         self.create_summary_csv = False
+        # column order of summary_statistics.csv: set at header-create time,
+        # or read back from the existing file on resume so appended rows
+        # always align with the on-disk header even when newer code grew
+        # extra metrics (which then go to telemetry/JSON only)
+        self._csv_keys: Optional[List[str]] = None
 
         # resume logic (experiment_builder.py:32-51)
         cont = str(cfg.continue_from_epoch)
@@ -127,14 +133,50 @@ class ExperimentBuilder:
         self.step_timer = StepTimer()
         self._active_pbar = None
         self._pbar_sums: Dict[str, tuple] = {}
-        self._tracing = False
-        self._profile_done = False
         self._steps_this_run = 0
         # multi-host: checkpoint saves are collective (orbax), but metric
         # files are written by the primary process only
         import jax
 
         self.is_primary = jax.process_index() == 0
+        # structured telemetry (telemetry/): JSONL event log + optional
+        # TensorBoard, no-op at telemetry_level='off' / non-primary hosts
+        self.telemetry = Telemetry(
+            cfg, self.logs_filepath, is_primary=self.is_primary
+        )
+        self.telemetry.event(
+            "run_start",
+            experiment_name=cfg.experiment_name,
+            telemetry_level=cfg.telemetry_level,
+            resume_iter=int(self.state["current_iter"]),
+            start_epoch=int(self.start_epoch),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+        # on-device dynamics stacks (telemetry_level='dynamics') buffered as
+        # DEVICE arrays per dispatch; converted + flushed at epoch-summary
+        # time so collection never adds a host sync to the hot loop
+        self._dyn_pending: List[tuple] = []
+        # scheduled profiler trace window (profile_epoch/profile_start_step/
+        # profile_num_steps on top of profile_trace_dir)
+        self.trace_window = TraceWindow(
+            cfg.profile_trace_dir,
+            num_steps=cfg.profile_num_steps,
+            epoch=cfg.profile_epoch,
+            start_step=cfg.profile_start_step,
+            on_event=lambda action, **f: self.telemetry.event(
+                "trace", action=action, **f
+            ),
+        )
+        # heartbeat hang watchdog: every host runs one (a multihost hang is
+        # typically visible from every process except the one that caused
+        # it); stall records go to stderr on every host and to the primary's
+        # telemetry log
+        self.watchdog = None
+        if cfg.watchdog_timeout_s > 0:
+            self.watchdog = Watchdog(
+                cfg.watchdog_timeout_s, on_stall=self._on_watchdog_stall
+            )
 
     # -- helpers (experiment_builder.py:66-100) ---------------------------
 
@@ -211,13 +253,71 @@ class ExperimentBuilder:
         for key, value in losses.items():
             total_losses.setdefault(key, []).append(value)
 
+    # -- telemetry plumbing ------------------------------------------------
+
+    def _beat(self, stage: str):
+        """Report train-loop progress to the hang watchdog."""
+        if self.watchdog is not None:
+            self.watchdog.beat(stage)
+
+    def _on_watchdog_stall(self, record: Dict):
+        """Called from the watchdog thread when progress stops: one loud
+        stderr line (every host) + the full diagnostic record with
+        all-thread stacks in the telemetry log (primary) — or, with
+        telemetry off, the stacks on stderr so the diagnosis is never
+        lost."""
+        print(
+            f"[watchdog] no progress for "
+            f"{record['seconds_since_progress']:.1f}s "
+            f"(stage={record['stage']!r}, beats={record['beat_count']})",
+            file=sys.stderr,
+            flush=True,
+        )
+        if self.telemetry.enabled:
+            self.telemetry.event("watchdog_stall", **record)
+        else:
+            for name, stack in record["stacks"].items():
+                print(f"[watchdog] thread {name}:\n{stack}",
+                      file=sys.stderr, flush=True)
+
+    def _pop_dynamics(self, losses: Dict, n_iters: int):
+        """Divert the on-device dynamics stacks (still device arrays) out of
+        the metric dict before accumulation; they flush at epoch-summary
+        time, never into the reference-compatible CSV."""
+        dyn = losses.pop("dynamics", None)
+        if dyn is not None:
+            self._dyn_pending.append(
+                (int(self.state["current_iter"]), n_iters, dyn)
+            )
+
+    def _flush_dynamics(self):
+        """Emit one ``dynamics`` record per fused dispatch. ONE batched
+        device->host fetch for the whole epoch's buffer (jax.device_get over
+        the list) — per-leaf np.asarray would issue thousands of sequential
+        transfers per epoch over a networked device transport."""
+        pending, self._dyn_pending = self._dyn_pending, []
+        if not self.telemetry.enabled or not pending:
+            return
+        import jax
+
+        pending = jax.device_get(pending)
+        for iter_start, n_iters, dyn in pending:
+            if isinstance(dyn, list):
+                # multihost fallback: per-iteration dicts, one record each
+                for j, d in enumerate(dyn):
+                    self.telemetry.dynamics(iter_start + j, 1, d)
+            else:
+                self.telemetry.dynamics(iter_start, n_iters, dyn)
+
     # -- phases -----------------------------------------------------------
 
     def train_iteration(self, train_sample, epoch_idx):
         # the sample passes through whole: the system dispatches on its form
         # (pixel tuple — x_s, x_t, y_s, y_t leading — or IndexBatch)
         self._maybe_profile_step()
+        self._beat("train_dispatch")
         losses = self.model.run_train_iter(train_sample, epoch=epoch_idx)
+        self._pop_dynamics(losses, 1)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += 1
         # with the model's one-step-lag sync, tick intervals equal device
@@ -235,7 +335,9 @@ class ExperimentBuilder:
             self.train_iteration(train_samples[0], epoch_idx)
             return
         self._maybe_profile_step()
+        self._beat("train_dispatch")
         losses = self.model.run_train_iters(list(train_samples), epoch=epoch_idx)
+        self._pop_dynamics(losses, len(train_samples))
         # ONE accumulation per chunk: device metrics arrive (k,)-stacked and
         # the epoch summary flattens them — per-iteration slicing here would
         # issue 2k tiny device programs per chunk (see run_train_iters)
@@ -244,33 +346,30 @@ class ExperimentBuilder:
         self.step_timer.tick()
         self._steps_this_run += len(train_samples)
 
+    def _sync_device(self):
+        """Drain in-flight dispatches (trace-window stop barrier)."""
+        import jax
+
+        jax.block_until_ready(self.model.state.net)
+
     def _maybe_profile_step(self):
-        """Capture a jax profiler trace of train iterations
-        [1, 1 + profile_num_steps) of this run when ``profile_trace_dir`` is
-        set (iteration 0 is compile, not steady state)."""
+        """Scheduled trace capture: iterations [profile_start_step,
+        profile_start_step + profile_num_steps) of ``profile_epoch``
+        (-1 = this run's first steps; iteration 0 is compile, not steady
+        state) when ``profile_trace_dir`` is set — see TraceWindow."""
         cfg = self.cfg
         if not cfg.profile_trace_dir:
             return
-        import jax
-
-        if (
-            not self._tracing
-            and not self._profile_done
-            and self._steps_this_run >= 1
-        ):
-            # ">= 1", not "== 1": chunked dispatch (steps_per_dispatch > 1)
-            # advances the step counter by k, so exact equality never fires
-            jax.profiler.start_trace(cfg.profile_trace_dir)
-            self._tracing = True
-        elif self._tracing and self._steps_this_run >= 1 + cfg.profile_num_steps:
-            # steps are dispatched asynchronously — drain the device before
-            # stopping so the trace actually contains the profiled steps
-            jax.block_until_ready(self.model.state.net)
-            jax.profiler.stop_trace()
-            self._tracing = False
-            self._profile_done = True
+        it = int(self.state["current_iter"])
+        self.trace_window.step(
+            epoch=it // cfg.total_iter_per_epoch,
+            step_in_epoch=it % cfg.total_iter_per_epoch,
+            step_in_run=self._steps_this_run,
+            sync=self._sync_device,
+        )
 
     def evaluation_iteration(self, val_sample, total_losses):
+        self._beat("eval_dispatch")
         losses, _ = self.model.run_validation_iter(val_sample)
         self._accumulate(losses, total_losses)
 
@@ -282,6 +381,7 @@ class ExperimentBuilder:
         if len(val_samples) == 1:
             self.evaluation_iteration(val_samples[0], total_losses)
             return
+        self._beat("eval_dispatch")
         losses, _ = self.model.run_validation_iters(list(val_samples))
         self._accumulate(losses, total_losses)
 
@@ -317,10 +417,37 @@ class ExperimentBuilder:
                 pbar.close()
         return self.build_summary_dict(total_losses, "val")
 
+    def _stream_metrics(self) -> Dict[str, float]:
+        """The loader producer's cumulative stats (episode assembly, queue
+        stall, prefetch-queue depth) over the epoch just finished, as
+        per-batch rates — visible in normal training runs' epoch summary,
+        not only under bench.py."""
+        stream = self.data.pop_stream_stats()
+        denom = max(1, int(stream["batches"]))
+        metrics = {
+            "stream_assembly_ms_per_batch": stream["assembly_s"] / denom * 1e3,
+            "stream_stall_ms_per_batch": stream["stall_s"] / denom * 1e3,
+            "stream_queue_depth_mean": stream["depth_sum"] / denom,
+        }
+        self.telemetry.event(
+            "stream",
+            epoch=int(self.epoch),
+            batches=int(stream["batches"]),
+            assembly_ms_per_batch=metrics["stream_assembly_ms_per_batch"],
+            stall_ms_per_batch=metrics["stream_stall_ms_per_batch"],
+            queue_depth_mean=metrics["stream_queue_depth_mean"],
+        )
+        return metrics
+
     def pack_and_save_metrics(self, train_losses, val_losses):
         """Per-epoch CSV/JSON metric rows (experiment_builder.py:208-245),
-        plus per-step timing stats the reference never had."""
-        epoch_summary = {**train_losses, **val_losses, **self.step_timer.summary()}
+        plus per-step timing and loader stream stats the reference never
+        had; mirrors the row to the telemetry sinks and flushes the
+        buffered on-device dynamics stacks."""
+        timing = self.step_timer.summary()
+        epoch_summary = {
+            **train_losses, **val_losses, **timing, **self._stream_metrics(),
+        }
         self.step_timer.reset()
         self.state.setdefault("per_epoch_statistics", {})
         for key, value in epoch_summary.items():
@@ -328,22 +455,57 @@ class ExperimentBuilder:
         epoch_summary["epoch"] = self.epoch
         epoch_summary["epoch_run_time"] = time.time() - self.start_time
         if self.create_summary_csv:
+            self._csv_keys = list(epoch_summary.keys())
             if self.is_primary:
                 save_statistics(
-                    self.logs_filepath, list(epoch_summary.keys()), create=True
+                    self.logs_filepath, self._csv_keys, create=True
                 )
             self.create_summary_csv = False
+        if self._csv_keys is None:
+            # resumed run: append in the on-disk header's column order — a
+            # header written by older code (fewer metric columns) must not
+            # get rows shifted out of register by newly-grown keys
+            self._csv_keys = (
+                self._existing_csv_header() or list(epoch_summary.keys())
+            )
+            if set(self._csv_keys) != set(epoch_summary):
+                self._log(
+                    "[builder] resumed summary CSV has a different column "
+                    "set than this build produces; rows stay aligned to "
+                    "the existing header, extra metrics appear in "
+                    "summary_statistics.json / telemetry only"
+                )
         self.start_time = time.time()
         self._log(f"epoch {self.epoch} -> " + ", ".join(
             f"{k}: {v:.4f}" for k, v in epoch_summary.items()
             if "loss" in k or "accuracy" in k
         ))
         if self.is_primary:
-            save_statistics(self.logs_filepath, list(epoch_summary.values()))
+            save_statistics(
+                self.logs_filepath,
+                [epoch_summary.get(k, "") for k in self._csv_keys],
+            )
+        # structured twins of the CSV row: epoch scalars (+ TensorBoard
+        # mirror), dispatch-timing stats, device memory vs the store
+        # registry's expectation, and the buffered on-device dynamics
+        self.telemetry.epoch_scalars(self.epoch, epoch_summary)
+        if self.telemetry.enabled:
+            if timing:
+                self.telemetry.event(
+                    "dispatch", epoch=int(self.epoch), **timing
+                )
+            self.telemetry.event(
+                "device_memory",
+                epoch=int(self.epoch),
+                **self.model.device_memory_stats(),
+            )
+        self._flush_dynamics()
 
     # -- the loop (experiment_builder.py:302-371) -------------------------
 
     def run_experiment(self):
+        if self.watchdog is not None:
+            self.watchdog.start()
         try:
             return self._run_experiment()
         finally:
@@ -353,17 +515,19 @@ class ExperimentBuilder:
             from . import checkpoint as ckpt
 
             try:
+                self._beat("checkpoint_barrier")
                 ckpt.wait_for_pending()
             finally:
                 # the trace only materialises at stop — don't lose it when
                 # the run ends/pauses/raises before profile_num_steps
                 # completes
-                if self._tracing:
-                    import jax
-
-                    jax.block_until_ready(self.model.state.net)
-                    jax.profiler.stop_trace()
-                    self._tracing = False
+                self.trace_window.close(self._sync_device)
+                if self.watchdog is not None:
+                    self.watchdog.stop()
+                # dynamics buffered since the last epoch flush (partial
+                # epoch at pause/crash), then the run_end marker
+                self._flush_dynamics()
+                self.telemetry.close()
 
     def _close_pbar(self):
         if self._active_pbar is not None:
@@ -455,9 +619,16 @@ class ExperimentBuilder:
                     # ONE save whose host-side clone materialises `latest`
                     # (one device->host serialization; the disk write
                     # overlaps the next epoch's training, see checkpoint.py)
-                    self.model.save_model(
+                    self._beat("checkpoint_save")
+                    ckpt_path = self.model.save_model(
                         self.saved_models_filepath, int(self.epoch),
                         self.state, also_latest=True,
+                    )
+                    self.telemetry.event(
+                        "checkpoint",
+                        epoch=int(self.epoch),
+                        path=ckpt_path,
+                        also_latest=True,
                     )
                     self._prune_saved_models()
                     self.total_losses = {}
@@ -529,6 +700,18 @@ class ExperimentBuilder:
                 remove_checkpoint(
                     self.saved_models_filepath, "train_model", epoch_idx
                 )
+
+    def _existing_csv_header(self) -> Optional[List[str]]:
+        """First row of the on-disk summary CSV (None when absent/empty)."""
+        import csv
+
+        path = os.path.join(self.logs_filepath, "summary_statistics.csv")
+        try:
+            with open(path) as f:
+                header = next(csv.reader(f))
+        except (OSError, StopIteration):
+            return None
+        return header or None
 
     def _highest_epoch_checkpoint_index(self) -> int:
         """Largest N with a finalized ``train_model_N`` directory on disk
@@ -630,6 +813,7 @@ class ExperimentBuilder:
         all_targets: List[np.ndarray] = []
 
         def flush(idx, samples):
+            self._beat("test_ensemble")
             _, preds = self.model.run_validation_iters(
                 list(samples), return_preds=True
             )

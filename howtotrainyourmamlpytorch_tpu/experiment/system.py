@@ -561,6 +561,31 @@ class MAMLFewShotClassifier:
         out_preds = np.asarray(preds) if return_preds else None
         return dict(metrics), out_preds
 
+    def device_memory_stats(self) -> Dict[str, Any]:
+        """Per-epoch device-memory telemetry: live HBM stats (when the
+        backend exposes them — TPU does, CPU reports nothing) next to the
+        store registry's *expectation* (bytes of every flat uint8 store
+        already made resident via ``_device_store``). A growing gap between
+        ``bytes_in_use`` and the expected resident set is the leak signal
+        the telemetry sink records each epoch."""
+        out: Dict[str, Any] = {
+            "store_bytes_expected": sum(
+                int(self._host_stores[name].nbytes)
+                for name in self._device_stores
+            ),
+            "stores_resident": sorted(self._device_stores),
+        }
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 - backend may not implement it
+            stats = None
+        if stats:
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                        "largest_alloc_size"):
+                if key in stats:
+                    out[key] = int(stats[key])
+        return out
+
     def gather_across_hosts(self, a: np.ndarray) -> np.ndarray:
         """Concatenate per-host arrays along axis 0 (identity single-host).
 

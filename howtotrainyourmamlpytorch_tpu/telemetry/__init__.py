@@ -1,0 +1,22 @@
+"""Structured telemetry: on-device training-dynamics metrics, JSONL /
+TensorBoard sinks, and the multihost hang watchdog.
+
+See ``schema.py`` for the event-record schema, ``sinks.py`` for the
+``Telemetry`` facade the experiment layer drives, and ``watchdog.py`` for
+the heartbeat hang watchdog.
+"""
+
+from .schema import (  # noqa: F401
+    KIND_FIELDS,
+    SCHEMA_VERSION,
+    iter_records,
+    validate_file,
+    validate_record,
+)
+from .sinks import (  # noqa: F401
+    TELEMETRY_FILENAME,
+    JsonlSink,
+    Telemetry,
+    TensorBoardSink,
+)
+from .watchdog import Watchdog, thread_stacks  # noqa: F401

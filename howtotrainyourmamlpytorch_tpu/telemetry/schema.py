@@ -1,0 +1,120 @@
+"""The structured telemetry event schema.
+
+Every record the :mod:`telemetry` sinks emit is one JSON object per line
+(JSONL) carrying three envelope fields — ``schema`` (the integer schema
+version), ``ts`` (unix seconds) and ``kind`` — plus the kind-specific
+payload fields listed in ``KIND_FIELDS``. The schema is versioned so
+downstream consumers (dashboards, regression tooling, the CI validation
+job) can reject records they do not understand instead of silently
+misreading them.
+
+Record kinds:
+
+* ``run_start`` / ``run_end`` — run lifecycle markers;
+* ``epoch``          — the per-epoch scalar summary (the CSV row's twin);
+* ``stream``         — loader producer stats (assembly/stall/queue depth);
+* ``dispatch``       — per-epoch dispatch-timing stats (StepTimer summary);
+* ``checkpoint``     — a checkpoint write (epoch index + path);
+* ``device_memory``  — HBM stats vs. the store registry's expectation;
+* ``dynamics``       — on-device training dynamics for one fused dispatch
+  (per-inner-step support/target losses, per-layer grad norms, LSLR values,
+  MSL weight vector);
+* ``trace``          — profiler trace-window start/stop;
+* ``watchdog_stall`` — the hang watchdog's diagnostic record (current
+  stage, seconds since progress, all-thread stack snapshot).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, Tuple
+
+SCHEMA_VERSION = 1
+
+#: kind -> required payload fields (beyond the schema/ts/kind envelope)
+KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "run_start": ("experiment_name", "telemetry_level", "resume_iter"),
+    "run_end": (),
+    "epoch": ("epoch", "scalars"),
+    "stream": ("epoch", "batches", "assembly_ms_per_batch",
+               "stall_ms_per_batch", "queue_depth_mean"),
+    "dispatch": ("epoch",),
+    "checkpoint": ("epoch", "path"),
+    "device_memory": ("epoch", "store_bytes_expected"),
+    "dynamics": ("iter_start", "num_iters", "support_losses",
+                 "target_losses", "grad_norms", "lslr", "msl_weights"),
+    "trace": ("action",),
+    "watchdog_stall": ("stage", "seconds_since_progress", "stacks"),
+}
+
+
+def validate_record(rec: Any) -> None:
+    """Raise ``ValueError`` when ``rec`` is not a valid telemetry record."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"telemetry record must be an object, got {type(rec).__name__}")
+    if rec.get("schema") != SCHEMA_VERSION:
+        raise ValueError(
+            f"unknown telemetry schema version {rec.get('schema')!r} "
+            f"(this validator understands {SCHEMA_VERSION})"
+        )
+    if not isinstance(rec.get("ts"), (int, float)):
+        raise ValueError(f"telemetry record missing numeric 'ts': {rec!r}")
+    kind = rec.get("kind")
+    if kind not in KIND_FIELDS:
+        raise ValueError(
+            f"unknown telemetry record kind {kind!r}; known kinds: "
+            f"{sorted(KIND_FIELDS)}"
+        )
+    missing = [f for f in KIND_FIELDS[kind] if f not in rec]
+    if missing:
+        raise ValueError(
+            f"telemetry record of kind {kind!r} missing required fields "
+            f"{missing}: {rec!r}"
+        )
+    if kind == "dynamics":
+        # the acceptance surface of the on-device collection: per-inner-step
+        # losses are lists, grad norms / LSLR are per-layer mappings
+        for field in ("support_losses", "target_losses", "msl_weights"):
+            if not isinstance(rec[field], list):
+                raise ValueError(
+                    f"dynamics record field {field!r} must be a list, got "
+                    f"{type(rec[field]).__name__}"
+                )
+        for field in ("grad_norms", "lslr"):
+            if not isinstance(rec[field], dict) or not rec[field]:
+                raise ValueError(
+                    f"dynamics record field {field!r} must be a non-empty "
+                    f"per-layer mapping, got {rec[field]!r}"
+                )
+
+
+def iter_records(path: str) -> Iterator[dict]:
+    """Yield parsed records from a telemetry JSONL file (no validation)."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: not valid JSON ({e})"
+                ) from e
+
+
+def validate_file(path: str) -> int:
+    """Validate every record in a telemetry JSONL file.
+
+    Returns the number of records; raises ``ValueError`` naming the first
+    offending line. This is what the CI schema-validation job runs against
+    the log a tiny telemetry-enabled train emits.
+    """
+    count = 0
+    for rec in iter_records(path):
+        try:
+            validate_record(rec)
+        except ValueError as e:
+            raise ValueError(f"{path}: record {count + 1}: {e}") from e
+        count += 1
+    return count

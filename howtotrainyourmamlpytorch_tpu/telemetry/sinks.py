@@ -1,0 +1,215 @@
+"""Telemetry sinks: JSONL structured event log + optional TensorBoard.
+
+The ``Telemetry`` facade is the one object the experiment layer talks to.
+It is a no-op when ``cfg.telemetry_level == 'off'`` or on non-primary
+hosts, so the hot loop can call it unconditionally; when enabled it writes
+schema-versioned records (:mod:`telemetry.schema`) to
+``logs/telemetry.jsonl`` and optionally mirrors scalar summaries to
+TensorBoard. All writes are lock-guarded — the hang watchdog emits records
+from its own thread.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .schema import SCHEMA_VERSION
+
+TELEMETRY_FILENAME = "telemetry.jsonl"
+
+
+def _jsonable(value: Any) -> Any:
+    """Recursively convert numpy/device arrays and scalars to JSON types.
+
+    Non-finite floats become null: json.dumps would otherwise emit bare
+    NaN/Infinity tokens, which Python's json accepts but spec-strict
+    consumers (jq, JSON.parse, warehouse loaders) reject — and a diverging
+    run is exactly when the log must stay machine-readable. The one
+    device->host synchronization for dynamics happens here, at flush time —
+    never inside the train loop.
+    """
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, float):
+        return value if math.isfinite(value) else None
+    if isinstance(value, (str, bool, int)) or value is None:
+        return value
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        return _jsonable(arr.item())
+    if not (np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_):
+        # float64 normalizes the extended float dtypes too (bfloat16 is
+        # dtype kind 'V', which issubdtype(..., floating) misses) so the
+        # finiteness mask can never be skipped for a float-like payload
+        arr = arr.astype(np.float64)
+        if not np.isfinite(arr).all():
+            out = arr.astype(object)
+            out[~np.isfinite(arr)] = None
+            return out.tolist()
+    return arr.tolist()
+
+
+class JsonlSink:
+    """Append-only JSONL event log (one schema-versioned record per line)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a")
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record)
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+            # flushed per record: the log's whole point is being readable
+            # while (or after) the run hangs/crashes
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def _import_summary_writer():
+    """Resolve a SummaryWriter class, or raise ImportError.
+
+    Prefers ``tensorboardX`` (pure-python, no TF dependency), falling back
+    to torch's bundled writer. Isolated in a function so tests can simulate
+    the no-TensorBoard environment by monkeypatching it.
+    """
+    try:
+        from tensorboardX import SummaryWriter  # type: ignore
+        return SummaryWriter
+    except ImportError:
+        from torch.utils.tensorboard import SummaryWriter  # type: ignore
+        return SummaryWriter
+
+
+class TensorBoardSink:
+    """Optional TensorBoard scalar sink.
+
+    Degrades to disabled (with one stderr note) when no SummaryWriter
+    implementation is importable — telemetry must never add a hard
+    dependency the container doesn't have.
+    """
+
+    def __init__(self, log_dir: str):
+        self.writer = None
+        try:
+            writer_cls = _import_summary_writer()
+        except ImportError:
+            print(
+                "[telemetry] TensorBoard sink requested but no SummaryWriter "
+                "available (tensorboardX / torch.utils.tensorboard); scalars "
+                "go to the JSONL log only",
+                file=sys.stderr,
+                flush=True,
+            )
+            return
+        self.writer = writer_cls(log_dir=log_dir)
+
+    @property
+    def enabled(self) -> bool:
+        return self.writer is not None
+
+    def scalars(self, step: int, values: Dict[str, Any]) -> None:
+        if self.writer is None:
+            return
+        for key, value in values.items():
+            try:
+                self.writer.add_scalar(key, float(value), int(step))
+            except (TypeError, ValueError):
+                continue  # non-scalar entries (lists, strings) are JSONL-only
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+
+
+class Telemetry:
+    """The experiment layer's telemetry facade.
+
+    ``level`` mirrors ``cfg.telemetry_level``: 'off' makes every method a
+    cheap no-op (the builder calls them unconditionally), 'scalars' writes
+    run/epoch/stream/checkpoint/memory/watchdog events, 'dynamics'
+    additionally receives the on-device training-dynamics stacks collected
+    inside the fused dispatches (see core.maml) via ``dynamics()``.
+    """
+
+    def __init__(self, cfg, log_dir: str, is_primary: bool = True):
+        self.level = getattr(cfg, "telemetry_level", "off")
+        self.enabled = bool(is_primary) and self.level != "off"
+        self.jsonl: Optional[JsonlSink] = None
+        self.tensorboard: Optional[TensorBoardSink] = None
+        if self.enabled:
+            self.jsonl = JsonlSink(os.path.join(log_dir, TELEMETRY_FILENAME))
+            if getattr(cfg, "telemetry_tensorboard", False):
+                self.tensorboard = TensorBoardSink(
+                    os.path.join(log_dir, "tensorboard")
+                )
+
+    # -- record emission ---------------------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Write one schema-versioned record (thread-safe)."""
+        if not self.enabled or self.jsonl is None:
+            return
+        record = {
+            "schema": SCHEMA_VERSION,
+            "ts": time.time(),
+            "kind": kind,
+            **_jsonable(fields),
+        }
+        self.jsonl.write(record)
+
+    def epoch_scalars(self, epoch: int, scalars: Dict[str, Any]) -> None:
+        """The per-epoch summary: one JSONL record + TensorBoard mirror."""
+        if not self.enabled:
+            return
+        numeric = {
+            k: v for k, v in scalars.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        self.event("epoch", epoch=int(epoch), scalars=numeric)
+        if self.tensorboard is not None:
+            self.tensorboard.scalars(int(epoch), numeric)
+
+    def dynamics(self, iter_start: int, num_iters: int,
+                 dyn: Dict[str, Any]) -> None:
+        """One fused dispatch's on-device dynamics stacks.
+
+        ``dyn`` is the nested dict the train step returned (device or host
+        arrays): per-inner-step ``support_losses``/``target_losses``,
+        per-layer ``grad_norms``/``lslr``, and the ``msl_weights`` vector.
+        The np.asarray conversion here is the only host sync, at flush time.
+        """
+        if not self.enabled:
+            return
+        self.event(
+            "dynamics",
+            iter_start=int(iter_start),
+            num_iters=int(num_iters),
+            **{k: dyn[k] for k in sorted(dyn)},
+        )
+
+    def close(self) -> None:
+        if self.tensorboard is not None:
+            self.tensorboard.close()
+        if self.jsonl is not None:
+            self.event("run_end")
+            self.jsonl.close()

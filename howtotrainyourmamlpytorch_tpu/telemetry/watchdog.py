@@ -1,0 +1,127 @@
+"""Heartbeat hang watchdog — the primitive multihost hang debugging needs.
+
+A multihost mesh hangs silently when one host misses a collective (a
+checkpoint barrier, a psum inside a dispatch) — every other host blocks in
+XLA with no Python-level symptom. The ``Watchdog`` is a daemon thread fed
+progress beats by the experiment loop (``beat(stage)`` at each dispatch /
+eval chunk / checkpoint); when no beat arrives for ``timeout_s`` it emits a
+diagnostic record — current stage, seconds since progress, and a stack
+snapshot of every live thread (which names the exact blocking call) —
+through the supplied callback, then re-arms on the next beat. One record
+per stall: a wedged run produces one loud diagnostic, not a log flood.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+
+def thread_stacks() -> Dict[str, str]:
+    """Formatted stack of every live thread, keyed ``name(ident)``."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}({ident})"
+        stacks[label] = "".join(traceback.format_stack(frame))
+    return stacks
+
+
+class Watchdog:
+    """Fires ``on_stall(record)`` when beats stop arriving for ``timeout_s``.
+
+    ``record`` carries ``stage`` (the last reported stage), ``beat_count``,
+    ``seconds_since_progress`` and ``stacks`` — ready to pass to
+    ``Telemetry.event("watchdog_stall", **record)``.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_stall: Callable[[dict], None],
+        poll_s: Optional[float] = None,
+        exclude_own_stack: bool = True,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.poll_s = poll_s if poll_s is not None else min(1.0, timeout_s / 4)
+        self.on_stall = on_stall
+        self._exclude_own = exclude_own_stack
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._last_beat = time.monotonic()
+        self._stage = "startup"
+        self._beats = 0
+        self._fired = False
+        self.stall_count = 0
+        self._thread: Optional[threading.Thread] = None
+
+    # -- producer side (the experiment loop) ------------------------------
+
+    def beat(self, stage: str) -> None:
+        """Report progress; cheap enough for every dispatch."""
+        with self._lock:
+            self._stage = stage
+            self._last_beat = time.monotonic()
+            self._beats += 1
+            self._fired = False  # re-arm after recovery
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        with self._lock:
+            # the stall clock runs from start(), not construction: a builder
+            # may be built long before run_experiment() begins beating
+            self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.poll_s * 4 + 1.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- monitor thread ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                stalled_for = time.monotonic() - self._last_beat
+                fired = self._fired
+                stage = self._stage
+                beats = self._beats
+                if stalled_for > self.timeout_s and not fired:
+                    self._fired = True
+                else:
+                    continue
+            stacks = thread_stacks()
+            if self._exclude_own:
+                stacks = {
+                    k: v for k, v in stacks.items()
+                    if not k.startswith("telemetry-watchdog(")
+                }
+            self.stall_count += 1
+            record = {
+                "stage": stage,
+                "beat_count": beats,
+                "seconds_since_progress": round(stalled_for, 3),
+                "timeout_s": self.timeout_s,
+                "stacks": stacks,
+            }
+            try:
+                self.on_stall(record)
+            except Exception:  # noqa: BLE001 - the watchdog must never kill the run
+                traceback.print_exc()

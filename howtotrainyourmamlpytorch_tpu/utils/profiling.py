@@ -6,6 +6,9 @@ anywhere (SURVEY.md §5). Here:
 
 * ``maybe_trace`` — context manager starting a JAX/XLA profiler trace
   (viewable in TensorBoard / Perfetto) when a trace dir is configured;
+* ``TraceWindow`` — scheduled trace capture: profile train iterations
+  [M, M+N) of a chosen epoch without code edits (config
+  ``profile_epoch`` / ``profile_start_step`` / ``profile_num_steps``);
 * ``StepTimer`` — cheap host-side wall-clock stats per training iteration,
   surfaced as ``train_iters_per_sec`` / ``train_step_time_ms`` epoch metrics.
 """
@@ -15,7 +18,7 @@ from __future__ import annotations
 import contextlib
 import random
 import time
-from typing import Dict, Iterator, Optional
+from typing import Callable, Dict, Iterator, Optional
 
 
 @contextlib.contextmanager
@@ -31,6 +34,87 @@ def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class TraceWindow:
+    """Schedules ONE jax profiler trace window over the train loop.
+
+    The window covers iterations ``[start_step, start_step + num_steps)``
+    of epoch ``epoch``; ``epoch=-1`` means "of THIS run", i.e. counted by
+    the run-local step counter regardless of resume epoch — the legacy
+    ``profile_trace_dir`` behaviour (iteration 0 is compile, so
+    ``start_step`` defaults to 1 upstream). Chunked dispatch
+    (``steps_per_dispatch``) advances counters by k per call, so every
+    comparison is ``>=``, never ``==``; the stop side counts steps actually
+    observed since the trace started. ``on_event(action, **fields)`` (when
+    given) reports start/stop transitions to the telemetry sink.
+    """
+
+    def __init__(
+        self,
+        trace_dir: str,
+        num_steps: int = 5,
+        epoch: int = -1,
+        start_step: int = 1,
+        on_event: Optional[Callable[..., None]] = None,
+    ):
+        self.trace_dir = trace_dir
+        self.num_steps = max(1, int(num_steps))
+        self.epoch = int(epoch)
+        self.start_step = max(0, int(start_step))
+        self.on_event = on_event
+        self.active = False
+        self.done = False
+        self._start_basis = 0
+
+    def _start(self, basis: int) -> None:
+        import jax
+
+        jax.profiler.start_trace(self.trace_dir)
+        self.active = True
+        self._start_basis = basis
+        if self.on_event is not None:
+            self.on_event("start", trace_dir=self.trace_dir, at_step=basis)
+
+    def _stop(self, sync: Optional[Callable[[], None]]) -> None:
+        import jax
+
+        if sync is not None:
+            # dispatches are asynchronous — drain the device before stopping
+            # so the trace actually contains the profiled steps
+            sync()
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        if self.on_event is not None:
+            self.on_event("stop", trace_dir=self.trace_dir)
+
+    def step(
+        self,
+        epoch: int,
+        step_in_epoch: int,
+        step_in_run: int,
+        sync: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Call before each train dispatch with the pre-dispatch counters."""
+        if not self.trace_dir or self.done:
+            return
+        if self.epoch < 0:
+            basis, in_window_epoch = step_in_run, True
+        else:
+            basis, in_window_epoch = step_in_epoch, epoch == self.epoch
+        if not self.active:
+            if in_window_epoch and basis >= self.start_step:
+                self._start(basis)
+        elif not in_window_epoch or basis >= self._start_basis + self.num_steps:
+            # left the target epoch, or captured the requested steps
+            self._stop(sync)
+
+    def close(self, sync: Optional[Callable[[], None]] = None) -> None:
+        """Stop a still-open window (run ended/paused/raised mid-capture) —
+        the trace only materialises at stop."""
+        if self.active:
+            self._stop(sync)
 
 
 class StepTimer:

@@ -46,6 +46,15 @@ def load_statistics(log_dir: str, filename: str = "summary_statistics.csv") -> D
     path = os.path.join(log_dir, filename)
     with open(path) as f:
         rows = list(csv.reader(f))
+    if not rows or not rows[0]:
+        # name the cause instead of the reference's bare rows[0] IndexError:
+        # an empty/headerless stats CSV means a crash truncated it (or a
+        # foreign file landed under logs/) and resume cannot trust it
+        raise ValueError(
+            f"stats CSV {path} is empty or has no header row — it was "
+            "likely truncated by a crash mid-write; delete it (or resume "
+            "with continue_from_epoch='from_scratch') to regenerate"
+        )
     keys = rows[0]
     data: Dict[str, List[str]] = {k: [] for k in keys}
     for row in rows[1:]:
@@ -55,8 +64,20 @@ def load_statistics(log_dir: str, filename: str = "summary_statistics.csv") -> D
 
 
 def save_to_json(filename: str, dict_to_store: dict) -> None:
-    with open(os.path.abspath(filename), "w") as f:
+    """Atomic JSON dump: write a sibling tmp file, fsync, ``os.replace``.
+
+    ``summary_statistics.json`` is rewritten whole every epoch; a crash
+    mid-write under the old truncate-in-place form left invalid JSON that
+    broke resume. The tmp+replace swap means readers only ever see the old
+    or the new complete file.
+    """
+    path = os.path.abspath(filename)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(dict_to_store, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def load_from_json(filename: str) -> dict:

@@ -61,6 +61,7 @@ def main() -> None:
                 # ranking
                 "BENCH_SKIP_EPOCH_BOUNDARY": "1",
                 "BENCH_SKIP_INPUT_PIPELINE": "1",
+                "BENCH_SKIP_TELEMETRY_OVERHEAD": "1",
             }
             if args.batch:
                 ov["BENCH_BATCH_SIZE"] = args.batch

@@ -73,6 +73,10 @@ def test_bench_prints_parsable_json_line():
     for tier in ("host", "uint8_stream", "device"):
         assert ip[tier]["assembly_ms_per_step"] >= 0
         assert ip[tier]["producer_stall_ms_per_step"] >= 0
+    # on-device dynamics collection cost is measured and self-describing
+    to = rec["telemetry_overhead"]
+    assert to["off_ms_per_step"] > 0 and to["dynamics_ms_per_step"] > 0
+    assert to["timed_steps"] >= 1
     assert rec["n_chips"] >= 1
     assert rec["dtype"] in ("float32", "bfloat16")
     # CPU has no published MXU peak -> mfu is null, never a bogus number

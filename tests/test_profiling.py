@@ -8,7 +8,101 @@ import numpy as np
 import pytest
 
 from howtotrainyourmamlpytorch_tpu.parallel import distributed, mesh as mesh_lib
-from howtotrainyourmamlpytorch_tpu.utils.profiling import StepTimer, maybe_trace
+from howtotrainyourmamlpytorch_tpu.utils.profiling import (
+    StepTimer,
+    TraceWindow,
+    maybe_trace,
+)
+
+
+class _FakeProfiler:
+    """Records start/stop calls in place of jax.profiler (monkeypatched)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, trace_dir):
+        self.calls.append(("start", trace_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    return fake
+
+
+def test_trace_window_default_matches_legacy_behavior(fake_profiler):
+    """epoch=-1/start_step=1: trace steps [1, 1+N) of this run — the old
+    profile_trace_dir semantics (step 0 is compile)."""
+    tw = TraceWindow("/tmp/t", num_steps=2, epoch=-1, start_step=1)
+    tw.step(epoch=0, step_in_epoch=0, step_in_run=0)
+    assert not tw.active  # step 0 = compile, skipped
+    tw.step(epoch=0, step_in_epoch=1, step_in_run=1)
+    assert tw.active
+    tw.step(epoch=0, step_in_epoch=2, step_in_run=2)
+    assert tw.active  # 1 step captured so far
+    tw.step(epoch=0, step_in_epoch=3, step_in_run=3)
+    assert not tw.active and tw.done
+    assert fake_profiler.calls == [("start", "/tmp/t"), ("stop",)]
+    # done: further steps never restart
+    tw.step(epoch=1, step_in_epoch=0, step_in_run=4)
+    assert len(fake_profiler.calls) == 2
+
+
+def test_trace_window_targets_chosen_epoch_and_step(fake_profiler):
+    """profile_epoch/profile_start_step select the window without code
+    edits; counters advancing by k (chunked dispatch) still trigger."""
+    synced = []
+    tw = TraceWindow("/tmp/t", num_steps=4, epoch=2, start_step=2)
+    tw.step(epoch=0, step_in_epoch=3, step_in_run=3)  # wrong epoch
+    tw.step(epoch=1, step_in_epoch=2, step_in_run=7)  # wrong epoch
+    assert not tw.active
+    tw.step(epoch=2, step_in_epoch=0, step_in_run=10)  # before start_step
+    assert not tw.active
+    # chunked dispatch jumps the step counter past start_step: >= triggers
+    tw.step(epoch=2, step_in_epoch=3, step_in_run=13)
+    assert tw.active
+    # leaving the target epoch clips the window even mid-capture
+    tw.step(epoch=3, step_in_epoch=0, step_in_run=15,
+            sync=lambda: synced.append(True))
+    assert not tw.active and tw.done
+    assert synced == [True]  # device drained before stop
+    assert fake_profiler.calls == [("start", "/tmp/t"), ("stop",)]
+
+
+def test_trace_window_close_stops_open_window(fake_profiler):
+    tw = TraceWindow("/tmp/t", num_steps=100, epoch=-1, start_step=0)
+    tw.step(epoch=0, step_in_epoch=0, step_in_run=0)
+    assert tw.active
+    tw.close()
+    assert not tw.active and tw.done
+    assert fake_profiler.calls == [("start", "/tmp/t"), ("stop",)]
+    tw.close()  # idempotent
+    assert len(fake_profiler.calls) == 2
+
+
+def test_trace_window_disabled_without_dir(fake_profiler):
+    tw = TraceWindow("", num_steps=2)
+    for i in range(5):
+        tw.step(epoch=0, step_in_epoch=i, step_in_run=i)
+    tw.close()
+    assert fake_profiler.calls == []
+
+
+def test_trace_window_reports_events(fake_profiler):
+    events = []
+    tw = TraceWindow(
+        "/tmp/t", num_steps=1, epoch=-1, start_step=1,
+        on_event=lambda action, **f: events.append((action, f)),
+    )
+    tw.step(epoch=0, step_in_epoch=1, step_in_run=1)
+    tw.step(epoch=0, step_in_epoch=2, step_in_run=2)
+    assert [e[0] for e in events] == ["start", "stop"]
+    assert events[0][1]["at_step"] == 1
 
 
 def test_step_timer_stats():

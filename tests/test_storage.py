@@ -1,0 +1,61 @@
+"""Metric storage: atomic JSON writes and resume-safe CSV loading."""
+
+import json
+import os
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.utils import storage
+
+
+def test_save_to_json_round_trip(tmp_path):
+    path = str(tmp_path / "summary_statistics.json")
+    storage.save_to_json(path, {"val_accuracy_mean": [0.5, 0.75]})
+    assert storage.load_from_json(path) == {"val_accuracy_mean": [0.5, 0.75]}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_save_to_json_crash_mid_write_keeps_old_file(tmp_path, monkeypatch):
+    """A crash while serializing must leave the previous complete file in
+    place (tmp + os.replace), never a truncated one that breaks resume."""
+    path = str(tmp_path / "summary_statistics.json")
+    storage.save_to_json(path, {"epoch": [1]})
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("simulated crash mid-serialization")
+
+    monkeypatch.setattr(storage.json, "dump", boom)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        storage.save_to_json(path, {"epoch": [1, 2]})
+    monkeypatch.undo()
+    # the original file is intact and valid JSON
+    assert storage.load_from_json(path) == {"epoch": [1]}
+    storage.save_to_json(path, {"epoch": [1, 2]})
+    assert storage.load_from_json(path) == {"epoch": [1, 2]}
+
+
+def test_load_statistics_round_trip(tmp_path):
+    storage.save_statistics(str(tmp_path), ["a", "b"], create=True)
+    storage.save_statistics(str(tmp_path), [1, 2])
+    data = storage.load_statistics(str(tmp_path))
+    assert data == {"a": ["1"], "b": ["2"]}
+
+
+def test_load_statistics_empty_csv_raises_clear_error(tmp_path):
+    """An empty/headerless stats CSV (crash-truncated) must raise a named
+    error, not the reference's bare IndexError on rows[0]."""
+    open(os.path.join(str(tmp_path), "summary_statistics.csv"), "w").close()
+    with pytest.raises(ValueError, match="empty or has no header"):
+        storage.load_statistics(str(tmp_path))
+
+
+def test_save_to_json_overwrites_corrupt_file(tmp_path):
+    """Recovery path: a pre-atomicity corrupted file is simply replaced by
+    the next complete write."""
+    path = str(tmp_path / "summary_statistics.json")
+    with open(path, "w") as f:
+        f.write('{"epoch": [1, 2')  # truncated JSON
+    with pytest.raises(json.JSONDecodeError):
+        storage.load_from_json(path)
+    storage.save_to_json(path, {"epoch": [3]})
+    assert storage.load_from_json(path) == {"epoch": [3]}

@@ -1,0 +1,505 @@
+"""Telemetry subsystem: JSONL/TensorBoard sinks + schema, the on-device
+training-dynamics collection (bit-identity with telemetry off, shapes and
+flush), the hang watchdog, and the end-to-end smoke run the CI
+schema-validation job executes."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import telemetry as tel
+from howtotrainyourmamlpytorch_tpu.core import partition
+from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
+from howtotrainyourmamlpytorch_tpu.telemetry import sinks as sinks_mod
+from howtotrainyourmamlpytorch_tpu.telemetry.watchdog import Watchdog
+
+
+def _batch(cfg, seed=0):
+    from conftest import make_synthetic_batch
+
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=seed)
+    return x_s, x_t, y_s, y_t  # the facade's (x_s, x_t, y_s, y_t) order
+
+
+# -- sinks + schema ---------------------------------------------------------
+
+
+def test_jsonl_sink_schema_roundtrip(tiny_cfg, tmp_path):
+    cfg = tiny_cfg.replace(telemetry_level="scalars")
+    t = tel.Telemetry(cfg, str(tmp_path))
+    assert t.enabled
+    t.event("run_start", experiment_name="exp", telemetry_level="scalars",
+            resume_iter=0)
+    t.epoch_scalars(1, {"train_loss_mean": 1.25, "val_accuracy_mean": 0.5,
+                        "note": "non-numeric is dropped from scalars"})
+    t.event("stream", epoch=1, batches=4, assembly_ms_per_batch=1.0,
+            stall_ms_per_batch=0.0, queue_depth_mean=1.5)
+    t.event("checkpoint", epoch=1, path="/tmp/ckpt", also_latest=True)
+    t.event("device_memory", epoch=1, store_bytes_expected=0)
+    t.close()
+    path = os.path.join(str(tmp_path), tel.TELEMETRY_FILENAME)
+    assert tel.validate_file(path) == 6  # incl. the run_end marker
+    recs = list(tel.iter_records(path))
+    assert [r["kind"] for r in recs] == [
+        "run_start", "epoch", "stream", "checkpoint", "device_memory",
+        "run_end",
+    ]
+    epoch_rec = recs[1]
+    assert epoch_rec["schema"] == tel.SCHEMA_VERSION
+    assert epoch_rec["scalars"] == {
+        "train_loss_mean": 1.25, "val_accuracy_mean": 0.5,
+    }
+
+
+def test_telemetry_off_is_noop(tiny_cfg, tmp_path):
+    t = tel.Telemetry(tiny_cfg, str(tmp_path))  # telemetry_level='off'
+    assert not t.enabled
+    t.event("run_start", experiment_name="x", telemetry_level="off",
+            resume_iter=0)
+    t.epoch_scalars(0, {"a": 1.0})
+    t.dynamics(0, 1, {})
+    t.close()
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           tel.TELEMETRY_FILENAME))
+
+
+def test_telemetry_disabled_on_non_primary(tiny_cfg, tmp_path):
+    cfg = tiny_cfg.replace(telemetry_level="scalars")
+    t = tel.Telemetry(cfg, str(tmp_path), is_primary=False)
+    assert not t.enabled
+    t.close()
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           tel.TELEMETRY_FILENAME))
+
+
+def test_validate_record_rejects_bad_records():
+    good = {"schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "run_end"}
+    tel.validate_record(good)
+    with pytest.raises(ValueError, match="schema version"):
+        tel.validate_record({**good, "schema": 999})
+    with pytest.raises(ValueError, match="unknown telemetry record kind"):
+        tel.validate_record({**good, "kind": "bogus"})
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record(
+            {"schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "epoch"}
+        )
+    with pytest.raises(ValueError, match="'ts'"):
+        tel.validate_record(
+            {"schema": tel.SCHEMA_VERSION, "kind": "run_end"}
+        )
+    # dynamics payload types are enforced (the acceptance surface)
+    dyn = {
+        "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "dynamics",
+        "iter_start": 0, "num_iters": 1, "support_losses": [1.0],
+        "target_losses": [1.0], "grad_norms": {"w": [1.0]},
+        "lslr": {"w": [0.1]}, "msl_weights": [1.0],
+    }
+    tel.validate_record(dyn)
+    with pytest.raises(ValueError, match="grad_norms"):
+        tel.validate_record({**dyn, "grad_norms": {}})
+    with pytest.raises(ValueError, match="support_losses"):
+        tel.validate_record({**dyn, "support_losses": 1.0})
+
+
+def test_validate_file_names_offending_line(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"schema": tel.SCHEMA_VERSION, "ts": 1.0,
+                            "kind": "run_end"}) + "\n")
+        f.write(json.dumps({"schema": tel.SCHEMA_VERSION, "ts": 1.0,
+                            "kind": "nope"}) + "\n")
+    with pytest.raises(ValueError, match="record 2"):
+        tel.validate_file(path)
+
+
+def test_tensorboard_sink_degrades_without_writer(tiny_cfg, tmp_path,
+                                                  monkeypatch):
+    """No SummaryWriter importable -> the sink disables itself and the
+    facade keeps working (JSONL only) — optional-import degradation."""
+
+    def no_writer():
+        raise ImportError("no tensorboard writer in this environment")
+
+    monkeypatch.setattr(sinks_mod, "_import_summary_writer", no_writer)
+    cfg = tiny_cfg.replace(
+        telemetry_level="scalars", telemetry_tensorboard=True
+    )
+    t = tel.Telemetry(cfg, str(tmp_path))
+    assert t.enabled
+    assert t.tensorboard is not None and not t.tensorboard.enabled
+    t.epoch_scalars(0, {"train_loss_mean": 1.0})
+    t.close()
+    assert tel.validate_file(
+        os.path.join(str(tmp_path), tel.TELEMETRY_FILENAME)
+    ) == 2
+
+
+def test_tensorboard_sink_writes_event_files(tiny_cfg, tmp_path):
+    pytest.importorskip("tensorboardX")
+    cfg = tiny_cfg.replace(
+        telemetry_level="scalars", telemetry_tensorboard=True
+    )
+    t = tel.Telemetry(cfg, str(tmp_path))
+    assert t.tensorboard is not None and t.tensorboard.enabled
+    t.epoch_scalars(0, {"train_loss_mean": 1.0, "val_accuracy_mean": 0.25})
+    t.close()
+    tb_dir = os.path.join(str(tmp_path), "tensorboard")
+    assert any("tfevents" in name for name in os.listdir(tb_dir))
+
+
+# -- on-device dynamics collection ------------------------------------------
+
+
+def test_dynamics_off_vs_on_metrics_bit_identical(tiny_cfg):
+    """telemetry_level='dynamics' must not change a single bit of the
+    training metrics or the learned parameters (the collection is aux-only,
+    stop_gradient'ed, and reduced outside the differentiated graph)."""
+    cfg_on = tiny_cfg.replace(telemetry_level="dynamics")
+    m_off = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    m_on = MAMLFewShotClassifier(cfg_on, use_mesh=False)
+    for step in range(2):
+        batch = _batch(tiny_cfg, seed=step)
+        l_off = m_off.run_train_iter(batch, epoch=0)
+        l_on = m_on.run_train_iter(batch, epoch=0)
+        assert "dynamics" not in l_off
+        assert "dynamics" in l_on
+        np.testing.assert_array_equal(
+            np.asarray(l_off["loss"]), np.asarray(l_on["loss"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(l_off["accuracy"]), np.asarray(l_on["accuracy"])
+        )
+    for key in m_off.state.net:
+        np.testing.assert_array_equal(
+            np.asarray(m_off.state.net[key]), np.asarray(m_on.state.net[key]),
+            err_msg=key,
+        )
+
+
+def test_dynamics_payload_shapes(tiny_cfg):
+    cfg = tiny_cfg.replace(telemetry_level="dynamics")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    losses = model.run_train_iter(_batch(cfg), epoch=0)
+    dyn = losses["dynamics"]
+    n_steps = cfg.number_of_training_steps_per_iter
+    adapted = sorted(
+        k for k in model.state.net if partition.is_inner_adapted(cfg, k)
+    )
+    assert np.asarray(dyn["support_losses"]).shape == (n_steps,)
+    assert np.asarray(dyn["target_losses"]).shape == (n_steps,)
+    assert np.asarray(dyn["msl_weights"]).shape == (n_steps,)
+    assert sorted(dyn["grad_norms"]) == adapted
+    assert sorted(dyn["lslr"]) == adapted
+    for name in adapted:
+        assert np.asarray(dyn["grad_norms"][name]).shape == (n_steps,)
+        assert np.all(np.asarray(dyn["grad_norms"][name]) >= 0)
+        # the reference's (num_inner_steps + 1,) LSLR shape
+        assert np.asarray(dyn["lslr"][name]).shape == (n_steps + 1,)
+    # MSL weights mirror the host schedule at epoch 0
+    from howtotrainyourmamlpytorch_tpu.core import msl
+
+    np.testing.assert_allclose(
+        np.asarray(dyn["msl_weights"]),
+        msl.loss_weights_for(
+            n_steps, cfg.use_multi_step_loss_optimization, True, 0,
+            cfg.multi_step_loss_num_epochs,
+        ),
+    )
+
+
+def test_dynamics_chunked_dispatch_stacks(tiny_cfg):
+    """steps_per_dispatch>1: dynamics come back (k, ...)-stacked from the
+    fused scan — one record per dispatch, zero extra device syncs."""
+    cfg = tiny_cfg.replace(telemetry_level="dynamics")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    batches = [_batch(cfg, seed=s) for s in range(3)]
+    losses = model.run_train_iters(batches, epoch=0)
+    dyn = losses["dynamics"]
+    n_steps = cfg.number_of_training_steps_per_iter
+    assert np.asarray(dyn["support_losses"]).shape == (3, n_steps)
+    assert np.asarray(dyn["target_losses"]).shape == (3, n_steps)
+    for name, v in dyn["grad_norms"].items():
+        assert np.asarray(v).shape == (3, n_steps), name
+    for name, v in dyn["lslr"].items():
+        assert np.asarray(v).shape == (3, n_steps + 1), name
+
+
+def test_eval_metrics_unaffected_by_dynamics_level(tiny_cfg):
+    cfg = tiny_cfg.replace(telemetry_level="dynamics")
+    m_off = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    m_on = MAMLFewShotClassifier(cfg, use_mesh=False)
+    batch = _batch(tiny_cfg)
+    l_off, _ = m_off.run_validation_iter(batch)
+    l_on, _ = m_on.run_validation_iter(batch)
+    assert "dynamics" not in l_on
+    np.testing.assert_array_equal(
+        np.asarray(l_off["loss"]), np.asarray(l_on["loss"])
+    )
+
+
+def test_jsonable_sanitizes_non_finite_floats():
+    """A diverging run (NaN loss) must still emit spec-strict JSON lines:
+    non-finite floats become null, never bare NaN/Infinity tokens."""
+    import ml_dtypes
+
+    out = sinks_mod._jsonable({
+        "a": float("nan"),
+        "b": [1.0, float("inf")],
+        "c": np.array([1.0, np.nan, -np.inf]),
+        "d": np.float32("nan"),
+        "e": np.array([[1.0, 2.0]]),
+        # bfloat16 (compute_dtype='bfloat16' dynamics) is dtype kind 'V',
+        # which a naive issubdtype(floating) finiteness gate would skip
+        "f": np.array([1.5, np.nan], dtype=ml_dtypes.bfloat16),
+        "g": np.array([1, 2], dtype=np.int32),
+    })
+    assert out == {
+        "a": None, "b": [1.0, None], "c": [1.0, None, None], "d": None,
+        "e": [[1.0, 2.0]], "f": [1.5, None], "g": [1, 2],
+    }
+    json.dumps(out, allow_nan=False)  # strict serialization succeeds
+
+
+def _stub_builder(tmp_path, cfg):
+    """A minimal stand-in exposing exactly the state
+    ``pack_and_save_metrics`` reads, with the real builder methods bound —
+    so the CSV header-alignment logic is tested without a dataset."""
+    import time as _time
+    from types import SimpleNamespace
+
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.utils.profiling import StepTimer
+
+    stub = SimpleNamespace(
+        cfg=cfg,
+        logs_filepath=str(tmp_path),
+        step_timer=StepTimer(),
+        state={},
+        epoch=1,
+        create_summary_csv=False,
+        _csv_keys=None,
+        is_primary=True,
+        start_time=_time.time(),
+        telemetry=tel.Telemetry(cfg.replace(telemetry_level="off"),
+                                str(tmp_path)),
+        data=SimpleNamespace(pop_stream_stats=lambda: {
+            "assembly_s": 0.01, "stall_s": 0.0, "depth_sum": 2.0,
+            "batches": 2,
+        }),
+        model=SimpleNamespace(
+            device_memory_stats=lambda: {"store_bytes_expected": 0}
+        ),
+        _dyn_pending=[],
+        _log=lambda msg: None,
+    )
+    for name in ("pack_and_save_metrics", "_stream_metrics",
+                 "_flush_dynamics", "_existing_csv_header"):
+        setattr(stub, name, getattr(ExperimentBuilder, name).__get__(stub))
+    return stub
+
+
+def test_resumed_csv_rows_align_to_old_header(tiny_cfg, tmp_path):
+    """Resuming a run whose CSV header predates newly-grown metric columns
+    must append rows in the OLD header's column order (extra metrics go to
+    telemetry/JSON only) — never positionally-shifted longer rows."""
+    import csv
+
+    from howtotrainyourmamlpytorch_tpu.utils.storage import (
+        load_statistics,
+        save_statistics,
+    )
+
+    old_header = ["train_loss_mean", "val_accuracy_mean", "epoch",
+                  "epoch_run_time"]
+    save_statistics(str(tmp_path), old_header, create=True)
+    save_statistics(str(tmp_path), [0.9, 0.5, 1, 12.0])
+
+    stub = _stub_builder(tmp_path, tiny_cfg)
+    stub.epoch = 2
+    stub.pack_and_save_metrics(
+        {"train_loss_mean": 0.8},
+        {"val_accuracy_mean": 0.6},
+    )
+    with open(os.path.join(str(tmp_path), "summary_statistics.csv")) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == old_header
+    assert all(len(r) == len(old_header) for r in rows[1:])
+    data = load_statistics(str(tmp_path))
+    assert data["epoch"] == ["1", "2"]
+    assert data["val_accuracy_mean"] == ["0.5", "0.6"]
+    # the stream columns this build grew were dropped from the CSV
+    assert "stream_assembly_ms_per_batch" not in data
+
+
+def test_fresh_csv_includes_stream_columns(tiny_cfg, tmp_path):
+    stub = _stub_builder(tmp_path, tiny_cfg)
+    stub.create_summary_csv = True
+    stub.pack_and_save_metrics(
+        {"train_loss_mean": 0.8}, {"val_accuracy_mean": 0.6}
+    )
+    from howtotrainyourmamlpytorch_tpu.utils.storage import load_statistics
+
+    data = load_statistics(str(tmp_path))
+    assert "stream_assembly_ms_per_batch" in data
+    assert "stream_queue_depth_mean" in data
+    assert data["epoch"] == ["1"]
+
+
+# -- watchdog ---------------------------------------------------------------
+
+
+def test_watchdog_clock_starts_at_start_not_construction():
+    """Construction-to-start delay must not count toward the stall timer
+    (a builder can exist long before run_experiment begins beating)."""
+    records = []
+    wd = Watchdog(0.3, on_stall=records.append, poll_s=0.05)
+    time.sleep(0.5)  # longer than the timeout, before start()
+    with wd:
+        time.sleep(0.1)  # well under the timeout after start()
+    assert records == []
+
+
+def test_watchdog_fires_on_stall():
+    records = []
+    wd = Watchdog(0.2, on_stall=records.append, poll_s=0.05)
+    with wd:
+        wd.beat("train_dispatch")
+        deadline = time.monotonic() + 5.0
+        while not records and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert len(records) == 1, "watchdog should fire exactly once per stall"
+    rec = records[0]
+    assert rec["stage"] == "train_dispatch"
+    assert rec["seconds_since_progress"] > 0.2
+    assert rec["beat_count"] == 1
+    # the stack snapshot names this (blocked) main thread
+    assert any("MainThread" in k for k in rec["stacks"])
+    assert any("sleep" in v or "wait" in v for v in rec["stacks"].values())
+
+
+def test_watchdog_stays_quiet_on_progress():
+    records = []
+    wd = Watchdog(0.5, on_stall=records.append, poll_s=0.05)
+    with wd:
+        end = time.monotonic() + 1.2
+        while time.monotonic() < end:
+            wd.beat("train_dispatch")
+            time.sleep(0.05)
+    assert records == []
+
+
+def test_watchdog_rearms_after_recovery():
+    records = []
+    wd = Watchdog(0.15, on_stall=records.append, poll_s=0.03)
+    with wd:
+        wd.beat("stall_one")
+        time.sleep(0.4)  # first stall fires once
+        wd.beat("stall_two")  # recovery re-arms
+        time.sleep(0.4)  # second stall fires once
+    assert [r["stage"] for r in records] == ["stall_one", "stall_two"]
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        Watchdog(0.0, on_stall=lambda r: None)
+
+
+# -- end-to-end smoke (the CI schema-validation job) ------------------------
+
+
+def test_builder_telemetry_e2e_smoke(tmp_path):
+    """A tiny telemetry-enabled train through ExperimentBuilder: the JSONL
+    log validates against the schema and contains per-inner-step losses,
+    per-layer grad norms and LSLR values for every train dispatch."""
+    from test_e2e_presplit import _write_presplit_rgb
+
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root))
+    cfg = MAMLConfig(
+        experiment_name=str(tmp_path / "exp_tel"),
+        dataset_name="mini_imagenet_full_size",
+        dataset_path=str(data_root),
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=10, image_width=10, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+        batch_size=2, cnn_num_filters=4, num_stages=2, max_pooling=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True, second_order=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_epochs=2, total_iter_per_epoch=4, num_evaluation_tasks=4,
+        total_epochs_before_pause=100,
+        num_dataprovider_workers=2, cache_dir=str(tmp_path / "cache"),
+        use_mmap_cache=True, use_remat=False, seed=0,
+        steps_per_dispatch=2,  # fused dispatch: dynamics arrive (k,)-stacked
+        eval_batches_per_dispatch=2,
+        telemetry_level="dynamics",
+        watchdog_timeout_s=120.0,  # enabled, but must stay quiet
+    )
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    builder = ExperimentBuilder(
+        cfg, model, MetaLearningDataLoader,
+        experiment_root=str(tmp_path), verbose=False,
+    )
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+
+    log_path = os.path.join(builder.logs_filepath, tel.TELEMETRY_FILENAME)
+    assert tel.validate_file(log_path) > 0
+    recs = list(tel.iter_records(log_path))
+    kinds = [r["kind"] for r in recs]
+    for expected in ("run_start", "epoch", "stream", "dispatch",
+                     "checkpoint", "device_memory", "dynamics", "run_end"):
+        assert expected in kinds, f"missing {expected!r} records"
+    assert "watchdog_stall" not in kinds
+    # every train dispatch produced one dynamics record: 2 epochs x 4 iters
+    # at steps_per_dispatch=2 -> 4 dispatches
+    dyn_recs = [r for r in recs if r["kind"] == "dynamics"]
+    assert len(dyn_recs) == 4
+    assert [r["iter_start"] for r in dyn_recs] == [0, 2, 4, 6]
+    n_steps = cfg.number_of_training_steps_per_iter
+    for rec in dyn_recs:
+        assert rec["num_iters"] == 2
+        arr = np.asarray(rec["support_losses"])
+        assert arr.shape == (2, n_steps) and np.all(np.isfinite(arr))
+        assert np.asarray(rec["target_losses"]).shape == (2, n_steps)
+        assert rec["grad_norms"] and rec["lslr"]
+        for norms in rec["grad_norms"].values():
+            assert np.asarray(norms).shape == (2, n_steps)
+        for lrs in rec["lslr"].values():
+            assert np.asarray(lrs).shape == (2, n_steps + 1)
+        assert np.asarray(rec["msl_weights"]).shape == (2, n_steps)
+    # per-epoch records carry the CSV row's scalars + the stream stats
+    epoch_recs = [r for r in recs if r["kind"] == "epoch"]
+    assert len(epoch_recs) == 2
+    for rec in epoch_recs:
+        assert "train_loss_mean" in rec["scalars"]
+        assert "val_accuracy_mean" in rec["scalars"]
+        assert "stream_assembly_ms_per_batch" in rec["scalars"]
+    # the CSV grew the stream columns and stays row-consistent
+    import csv
+
+    with open(os.path.join(builder.logs_filepath,
+                           "summary_statistics.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 2
+    assert "stream_assembly_ms_per_batch" in rows[0]
+
+
+def test_config_validates_telemetry_knobs(tiny_cfg):
+    with pytest.raises(ValueError, match="telemetry_level"):
+        tiny_cfg.replace(telemetry_level="bogus")
+    with pytest.raises(ValueError, match="watchdog_timeout_s"):
+        tiny_cfg.replace(watchdog_timeout_s=-1.0)
+    with pytest.raises(ValueError, match="profile_start_step"):
+        tiny_cfg.replace(profile_start_step=-2)
+    assert tiny_cfg.replace(telemetry_level="scalars").telemetry_level == "scalars"
