@@ -434,7 +434,8 @@ def _measure_telemetry_overhead(
 
             state = mesh_lib.replicate_state(mesh, state)
         step = jax.jit(
-            maml.make_train_step(tcfg, second_order=True), donate_argnums=(0,)
+            maml.make_train_step(tcfg, second_order=True),
+            donate_argnums=maml.TRAIN_DONATE,
         )
         x_s, y_s, x_t, y_t = batch
         state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)  # compile
@@ -470,7 +471,94 @@ _WORKLOAD_KNOBS = (
     "BENCH_NUMBER_OF_EVALUATION_STEPS_PER_ITER",
     "BENCH_COMPUTE_DTYPE", "BENCH_USE_REMAT", "BENCH_REMAT_POLICY",
     "BENCH_CONV_IMPL", "BENCH_POOL_IMPL", "BENCH_TASK_AXIS_MODE",
+    "BENCH_PAD_CHANNELS",
 )
+
+# scalar cost_analysis keys surfaced whole; any OTHER key the backend
+# exposes (e.g. per-category entries on TPU builds) lands in the breakdown
+# dict — except the per-operand "bytes accessedN{}" / "utilizationN{}"
+# noise, which is filtered out entirely (it scales with operand count and
+# would bloat the bench line without naming an op class)
+_HLO_SCALAR_KEYS = ("flops", "transcendentals", "bytes accessed",
+                    "optimal_seconds")
+
+
+def _cost_analysis_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one dict (older jax
+    returns ``[dict]``, newer a plain dict) — the single normalization
+    point for main() and the breakdown below."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return ca
+
+
+def _hlo_cost_breakdown(compiled, ca: dict) -> dict | None:
+    """Per-category HLO cost summary of the flagship step executable.
+
+    Combines XLA's cost analysis ``ca`` (total flops / bytes accessed, plus
+    any per-category entries the backend exposes) with an opcode census of
+    the optimized HLO (instruction counts per op class — dot vs convolution
+    vs fusion ...), so a lowering regression (e.g. the task-batched GEMM
+    conv silently falling back to grouped convolutions) is visible in the
+    BENCH_* trajectory without a profiler. Best-effort: returns None when
+    the backend exposes neither surface.
+    """
+    import re
+
+    out: dict = {}
+    try:
+        for key in _HLO_SCALAR_KEYS:
+            if key in ca:
+                out[key.replace(" ", "_")] = float(ca[key])
+        breakdown = {
+            k: float(v)
+            for k, v in ca.items()
+            if k not in _HLO_SCALAR_KEYS
+            and not re.fullmatch(r"(bytes accessed|utilization)\w*\{\}", k)
+        }
+        if breakdown:
+            out["cost_breakdown"] = breakdown
+    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
+        print(f"bench: cost_analysis breakdown unavailable ({e!r})",
+              file=sys.stderr)
+    try:
+        ops: dict = {}
+        for m in re.finditer(r"=\s+\S+\s+([a-z][a-z0-9-]*)\(",
+                             compiled.as_text()):
+            ops[m.group(1)] = ops.get(m.group(1), 0) + 1
+        # only the op classes that distinguish a GEMM-lowered step from a
+        # grouped-conv/relayout-heavy one — the full census would bloat the
+        # bench line with elementwise noise
+        interesting = (
+            "dot", "convolution", "fusion", "custom-call", "all-reduce",
+            "all-gather", "reduce-scatter", "copy", "transpose", "pad",
+            "gather", "scatter", "while",
+        )
+        census = {k: ops[k] for k in interesting if k in ops}
+        if census:
+            out["hlo_op_counts"] = census
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: HLO op census unavailable ({e!r})", file=sys.stderr)
+    return out or None
+
+
+def _donation_stats(compiled, donate_argnums) -> dict | None:
+    """Aliasing/donation figures of the compiled step: a donation regression
+    (state no longer aliased in place -> double-buffered params+Adam in HBM)
+    shows up as alias_size_bytes collapsing toward zero."""
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "donate_argnums": list(donate_argnums),
+            "alias_size_bytes": int(ma.alias_size_in_bytes),
+            "argument_size_bytes": int(ma.argument_size_in_bytes),
+            "output_size_bytes": int(ma.output_size_in_bytes),
+            "temp_size_bytes": int(ma.temp_size_in_bytes),
+        }
+    except Exception as e:  # noqa: BLE001 - memory analysis is best-effort
+        print(f"bench: memory_analysis unavailable ({e!r})", file=sys.stderr)
+        return {"donate_argnums": list(donate_argnums)}
 
 
 def main() -> None:
@@ -514,6 +602,9 @@ def main() -> None:
         overrides["task_axis_mode"] = os.environ["BENCH_TASK_AXIS_MODE"]
     if "BENCH_POOL_IMPL" in os.environ:
         overrides["pool_impl"] = os.environ["BENCH_POOL_IMPL"]
+    if "BENCH_PAD_CHANNELS" in os.environ:
+        # 'auto' | 'off' | 'tile' | integer multiple (config validates)
+        overrides["pad_channels"] = os.environ["BENCH_PAD_CHANNELS"]
     if "BENCH_USE_REMAT" in os.environ:
         raw = os.environ["BENCH_USE_REMAT"].lower()
         if raw not in ("true", "false", "0", "1"):
@@ -560,17 +651,24 @@ def main() -> None:
     # donate the state like the real system does (experiment/system.py) —
     # without it the TPU keeps two copies of params+Adam state alive
     step = jax.jit(
-        maml.make_train_step(cfg, second_order=True), donate_argnums=(0,)
+        maml.make_train_step(cfg, second_order=True),
+        donate_argnums=maml.TRAIN_DONATE,
     )
     # AOT-compile first so we can read XLA's own FLOPs count for this exact
-    # executable (validates the analytic model; see test_flops_model.py).
+    # executable (validates the analytic model; see test_flops_model.py),
+    # the per-category HLO cost breakdown, and the donation/aliasing stats.
     # The jit call below hits the same executable cache — no double compile.
     xla_flops_per_batch = None
+    hlo_cost = None
+    donation = None
     try:
         compiled = step.lower(
             state, x_s, y_s, x_t, y_t, weights, 1e-3
         ).compile()
-        xla_flops_per_batch = float(compiled.cost_analysis()["flops"])
+        ca = _cost_analysis_dict(compiled)
+        xla_flops_per_batch = float(ca["flops"])
+        hlo_cost = _hlo_cost_breakdown(compiled, ca)
+        donation = _donation_stats(compiled, maml.TRAIN_DONATE)
     except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
         print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
 
@@ -679,11 +777,17 @@ def main() -> None:
         "batch_size": b,
         "conv_impl": cfg.resolved_conv_impl,
         "pool_impl": cfg.resolved_pool_impl,
+        "pad_channels": cfg.resolved_pad_channels,
         "task_axis_mode": cfg.task_axis_mode,
         "use_remat": cfg.use_remat,
         "remat_policy": cfg.remat_policy if cfg.use_remat else None,
         "matmul_precision": cfg.resolved_matmul_precision,
         "reduced": reduced,
+        # per-category HLO cost of the flagship step executable + the
+        # donation/aliasing figures (informational — a lowering or aliasing
+        # regression is visible here before it shows in throughput)
+        "hlo_cost": hlo_cost,
+        "donation": donation,
         # the serial tail between epochs: fused-val + checkpoint seconds
         # (informational — not part of baseline comparability)
         "epoch_boundary": epoch_boundary,
@@ -721,8 +825,8 @@ def main() -> None:
     # defaults landed) is stale, not a comparison point.
     _COMPARABLE_KEYS = (
         "backend", "dtype", "batch_size", "n_chips", "conv_impl",
-        "pool_impl", "task_axis_mode", "use_remat", "remat_policy",
-        "matmul_precision", "workload",
+        "pool_impl", "pad_channels", "task_axis_mode", "use_remat",
+        "remat_policy", "matmul_precision", "workload",
     )
     comparable = (
         baseline_rec is not None
@@ -748,7 +852,8 @@ def main() -> None:
             k: v for k, v in result.items()
             if k not in ("vs_baseline", "baseline_backend",
                          "baseline_refreshed", "epoch_boundary",
-                         "input_pipeline", "telemetry_overhead")
+                         "input_pipeline", "telemetry_overhead",
+                         "hlo_cost", "donation")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

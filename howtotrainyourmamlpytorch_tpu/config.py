@@ -133,11 +133,28 @@ class MAMLConfig:
     # friendly, the TPU default); 'map' runs tasks sequentially with ordinary
     # convs — 5-10x faster on CPU hosts where XLA's grouped-conv path is slow
     task_axis_mode: str = "vmap"
-    # conv lowering: 'lax' = native conv (XLA tiles it onto the MXU — the
-    # TPU path); 'im2col' = patches + dot_general, whose every AD order is a
-    # GEMM — sidesteps XLA:CPU's ~40x-slow kernel-gradient conv (see
-    # ops.functional.conv2d); 'auto' = im2col on CPU backends, lax elsewhere
+    # conv lowering: 'lax' = native conv (XLA tiles it onto the MXU — right
+    # when the kernel is shared across the batch); 'im2col' = patches +
+    # dot_general, whose every AD order is a GEMM — sidesteps XLA:CPU's
+    # ~40x-slow kernel-gradient conv (see ops.functional.conv2d); 'gemm' =
+    # the task-batched dot_general conv — under vmap with per-task adapted
+    # weights (every inner step past the first) each layer lowers to ONE
+    # batched (task, N*Ho*Wo, K) x (task, K, cout) GEMM instead of the
+    # grouped conv XLA runs an order of magnitude below MXU peak; 'auto' =
+    # im2col on CPU backends, gemm on accelerators when task_axis_mode
+    # batches per-task weights ('vmap'), lax otherwise
     conv_impl: str = "auto"
+    # compute-only channel padding to the MXU lane/sublane tile: 'auto'
+    # (off on CPU; the 'tile' rule on accelerators), 'tile' (force the rule
+    # on any backend: round channel counts to the next power of two,
+    # floored at the dtype sublane tile and snapped to multiples of the
+    # 128-lane width — the flagship's 48 filters compute as 64), 'off', or
+    # an explicit integer multiple. Zero channels contribute nothing to the
+    # contraction and outputs are sliced back to logical channels before
+    # bias/norm, so results are bit-exact with the unpadded op under the
+    # tile rule (tests/test_pad_channels.py) while every GEMM dimension
+    # tiles cleanly
+    pad_channels: Union[str, int] = "auto"
     # pool lowering: 'reshape' = tile-axes reshape + max, whose gradient is
     # an elementwise mask (~10x faster than select-and-scatter on CPU);
     # 'reduce_window' = XLA's native window reduce — on TPU the reshape
@@ -216,9 +233,13 @@ class MAMLConfig:
     # loop reports no progress for this many seconds (multihost hang
     # debugging: the stack names the blocking collective). 0 disables.
     watchdog_timeout_s: float = 0.0
-    # persistent XLA compilation cache: resumed runs skip the 20-40s TPU
-    # compile of the train/eval steps ('' => disabled)
-    compilation_cache_dir: str = ""
+    # persistent XLA compilation cache: resumed runs (and repeated runs of
+    # the same config) skip the 20-40s TPU compile of the train/eval steps.
+    # 'auto' (default) => <experiment_dir>/xla_cache, resolved by the
+    # experiment builder once the experiment folder exists (standalone
+    # system/bench use leaves it disabled); '' => disabled; any other
+    # string => that directory
+    compilation_cache_dir: str = "auto"
 
     # --- accepted-but-inert reference keys (SURVEY.md §5 "dead keys") ----
     dropout_rate_value: float = 0.0
@@ -265,10 +286,22 @@ class MAMLConfig:
                 f"task_axis_mode must be 'vmap' or 'map', got "
                 f"{self.task_axis_mode!r}"
             )
-        if self.conv_impl not in ("auto", "lax", "im2col"):
+        if self.conv_impl not in ("auto", "lax", "im2col", "gemm"):
             raise ValueError(
-                f"conv_impl must be 'auto', 'lax' or 'im2col', got "
+                f"conv_impl must be 'auto', 'lax', 'im2col' or 'gemm', got "
                 f"{self.conv_impl!r}"
+            )
+        # pad_channels: 'auto' | 'off' | 'tile' | positive int (JSON
+        # configs may carry the multiple as a string — coerce digits)
+        if isinstance(self.pad_channels, str) and self.pad_channels.isdigit():
+            self.pad_channels = int(self.pad_channels)
+        if isinstance(self.pad_channels, bool) or not (
+            self.pad_channels in ("auto", "off", "tile")
+            or (isinstance(self.pad_channels, int) and self.pad_channels > 0)
+        ):
+            raise ValueError(
+                f"pad_channels must be 'auto', 'off', 'tile' or a positive "
+                f"int, got {self.pad_channels!r}"
             )
         if self.pool_impl not in ("auto", "reshape", "reduce_window"):
             raise ValueError(
@@ -373,13 +406,36 @@ class MAMLConfig:
 
     @property
     def resolved_conv_impl(self) -> str:
-        """'auto' resolved against the live backend: im2col's every-AD-order-
-        is-a-GEMM lowering wins on CPU; the native conv wins on the MXU."""
+        """'auto' resolved against the live backend AND the task-axis mode.
+
+        CPU: im2col (every AD order is a GEMM — sidesteps XLA:CPU's ~40x
+        kernel-gradient conv). Accelerators: when ``task_axis_mode='vmap'``
+        the inner loop carries per-task adapted weights, so every conv is a
+        batched-*weights* conv — the native lowering is a
+        ``feature_group_count=tasks`` grouped conv that XLA runs an order of
+        magnitude below MXU peak, while the 'gemm' lowering folds each layer
+        into one large batched GEMM; with ``task_axis_mode='map'`` weights
+        stay unbatched and the native conv is what the MXU tiles best.
+        """
         if self.conv_impl != "auto":
             return self.conv_impl
         import jax
 
-        return "im2col" if jax.default_backend() == "cpu" else "lax"
+        if jax.default_backend() == "cpu":
+            return "im2col"
+        return "gemm" if self.task_axis_mode == "vmap" else "lax"
+
+    @property
+    def resolved_pad_channels(self) -> Union[str, int]:
+        """'auto' resolved against the live backend: compute-only channel
+        padding pays off where the MXU tiles GEMM operands in (sublane,
+        128-lane) blocks; on CPU it is pure overhead, so 'auto' disables it.
+        Explicit 'off' / 'tile' / int values apply everywhere."""
+        if self.pad_channels != "auto":
+            return self.pad_channels
+        import jax
+
+        return "off" if jax.default_backend() == "cpu" else "tile"
 
     @property
     def resolved_matmul_precision(self) -> str:

@@ -62,6 +62,31 @@ class MetaState(NamedTuple):
     opt: Any
 
 
+# -- buffer-donation contract ------------------------------------------------
+#
+# Every train step consumes a MetaState and returns the next one; without
+# donation XLA must double-buffer params + LSLR + BN + Adam moments in HBM on
+# every dispatch. ``TRAIN_DONATE`` is the single source of truth for the
+# donated argnums of every ``make_train_step*`` variant (plain, multi,
+# indexed, multi-indexed) — used by experiment/system.py and bench.py:
+# the state (argnum 0) aliases in place onto the returned state (identical
+# pytree of shapes), halving the steady-state HBM footprint of
+# params+LSLR+BN+Adam. The caller must re-bind its reference to the returned
+# state (the system facade does) and never touch the donated one again;
+# checkpointing stays safe because ``save_checkpoint_async`` finishes the
+# device->host copy before returning (experiment/checkpoint.py), and the
+# indexed variants never donate argnum 1 — the resident uint8 store is a
+# registry-owned invariant reused by every subsequent dispatch.
+#
+# Eval deliberately donates NOTHING: the state is not legal to donate (eval
+# returns no replacement and the caller keeps dispatching the same state),
+# and donating the placed pixel/index batches is not usable — no output
+# shares their shape, so XLA cannot alias them, jax warns, and the buffers
+# are not even freed early (measured on the CPU backend; tested in
+# tests/test_donation.py).
+TRAIN_DONATE = (0,)
+
+
 def cosine_lr(cfg: MAMLConfig, epoch: int) -> float:
     """CosineAnnealingLR closed form, stepped per-iteration with the integer
     epoch index exactly like the reference (few_shot_learning_system.py:70-71,
@@ -245,11 +270,18 @@ def _merge_bn(bn_batched: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
 def _map_tasks(learner_call, mode, x_s, y_s, x_t, y_t):
     """Run the per-task learner over the task axis.
 
-    'vmap' (default): one batched program — per-task adapted weights make
-    the convs *grouped* convs, which the MXU eats but XLA:CPU's conv path
-    handles an order of magnitude below peak. 'map' (lax.map = scan):
-    sequential per-task execution with ordinary convs — the right choice on
-    CPU hosts (measured 5-10x faster at 64 filters), numerically equivalent.
+    'vmap' (default): one batched program. After inner step 1 every task
+    carries its own adapted weights, so each conv is a batched-*weights*
+    conv — under ``conv_impl='lax'`` that lowers to a
+    ``feature_group_count=tasks`` grouped conv NO backend runs near peak
+    (XLA:CPU an order of magnitude below; the TPU grouped-conv path far off
+    the MXU's large-GEMM rate), which is why ``resolved_conv_impl`` picks
+    the 'gemm' lowering on accelerators: the batching rule then folds every
+    layer into ONE (task, N*Ho*Wo, K) x (task, K, cout) batched GEMM at
+    every derivative order (ops.functional.conv2d). 'map' (lax.map = scan):
+    sequential per-task execution with ordinary shared-weight convs — the
+    right choice on single-core CPU hosts (measured 5-10x faster at 64
+    filters), numerically equivalent.
     """
     if mode == "map":
         return jax.lax.map(lambda a: learner_call(*a), (x_s, y_s, x_t, y_t))

@@ -59,6 +59,21 @@ class ExperimentBuilder:
             self.samples_filepath,
         ) = build_experiment_folder(cfg.experiment_name, root=experiment_root)
 
+        # persistent XLA compilation cache: 'auto' (default) lives under the
+        # experiment dir just created, so reruns and kill-safe resumes of an
+        # experiment load compiled executables instead of repaying the
+        # 20-40s TPU step/eval compiles. Resolved here (not in the system
+        # facade) because only the builder knows the experiment root; the
+        # first compile happens at the first dispatch, well after this.
+        cache_dir = cfg.compilation_cache_dir
+        if cache_dir == "auto":
+            cache_dir = os.path.join(
+                os.path.dirname(self.logs_filepath), "xla_cache"
+            )
+        from .system import enable_compilation_cache
+
+        enable_compilation_cache(cache_dir)
+
         self.total_losses: Dict[str, List[float]] = {}
         self.state: Dict = {"best_val_acc": 0.0, "best_val_iter": 0, "current_iter": 0}
         self.start_epoch = 0

@@ -69,19 +69,42 @@ def _to_nhwc(
     )
 
 
+def enable_compilation_cache(cache_dir: Optional[str]) -> None:
+    """Point jax's persistent compilation cache at ``cache_dir`` (falsy =>
+    disabled). The 1-second min-compile-time floor keeps trivial CPU-test
+    programs out of the cache while every real train/eval step (20-40s TPU
+    compiles) is persisted — repeated runs and kill-safe resumes then load
+    the executable instead of recompiling."""
+    jax.config.update("jax_compilation_cache_dir", cache_dir or None)
+    if cache_dir:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 class MAMLFewShotClassifier:
-    """Host-side system object owning state + compiled steps."""
+    """Host-side system object owning state + compiled steps.
+
+    Every train-step executable donates the state argument
+    (``maml.TRAIN_DONATE``): the old state buffers alias the returned
+    state's, so params + LSLR + BN + Adam moments are single-buffered in
+    HBM across dispatches. ``self.state`` is re-bound to the returned state
+    at every dispatch site, and checkpoint saves copy device->host before
+    returning, so no consumer can observe a donated buffer. Eval donates
+    nothing (see the contract note in core/maml.py)."""
 
     def __init__(self, cfg: MAMLConfig, use_mesh: bool = True):
         self.cfg = cfg
         # persistent XLA compile cache: a resumed (kill-safe) run reuses the
-        # previous run's compiled train/eval steps. Always written (None
-        # disables) so a prior instance's setting never leaks into this one.
-        jax.config.update(
-            "jax_compilation_cache_dir", cfg.compilation_cache_dir or None
+        # previous run's compiled train/eval steps. 'auto' (the default) is
+        # resolved by the experiment builder to <experiment_dir>/xla_cache
+        # once the experiment folder exists (the builder is constructed
+        # after this and overrides) — until then 'auto' resets the cache to
+        # disabled, so standalone system users (bench, tests) run uncached
+        # and a prior instance's setting never leaks into this one.
+        enable_compilation_cache(
+            None
+            if cfg.compilation_cache_dir == "auto"
+            else cfg.compilation_cache_dir
         )
-        if cfg.compilation_cache_dir:
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         self.current_epoch = 0
         self.state = maml.init_state(cfg)
         self.mesh = None
@@ -146,7 +169,7 @@ class MAMLFewShotClassifier:
         if second_order not in self._train_steps:
             self._train_steps[second_order] = jax.jit(
                 maml.make_train_step(self.cfg, second_order),
-                donate_argnums=(0,),
+                donate_argnums=maml.TRAIN_DONATE,
             )
         return self._train_steps[second_order]
 
@@ -155,7 +178,7 @@ class MAMLFewShotClassifier:
         if key not in self._train_multi_steps:
             self._train_multi_steps[key] = jax.jit(
                 maml.make_train_multi_step(self.cfg, second_order),
-                donate_argnums=(0,),
+                donate_argnums=maml.TRAIN_DONATE,
             )
         return self._train_multi_steps[key]
 
@@ -171,7 +194,8 @@ class MAMLFewShotClassifier:
         if key not in self._train_steps_indexed:
             self._train_steps_indexed[key] = jax.jit(
                 maml.make_train_step_indexed(self.cfg, second_order, augment),
-                donate_argnums=(0,),  # state only — never the resident store
+                # state only — never the resident store (argnum 1)
+                donate_argnums=maml.TRAIN_DONATE,
             )
         return self._train_steps_indexed[key]
 
@@ -182,7 +206,7 @@ class MAMLFewShotClassifier:
                 maml.make_train_multi_step_indexed(
                     self.cfg, second_order, augment
                 ),
-                donate_argnums=(0,),
+                donate_argnums=maml.TRAIN_DONATE,
             )
         return self._train_multi_steps_indexed[key]
 

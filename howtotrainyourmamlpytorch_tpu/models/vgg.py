@@ -162,6 +162,10 @@ def apply(
     out = x.astype(dtype)
     stride = 1 if cfg.max_pooling else 2
     pad = 1 if cfg.conv_padding else 0
+    # compute-only MXU channel padding, resolved once per trace; conv/linear
+    # slice back to logical channels before bias/norm so the math is
+    # bit-exact with the unpadded program (ops.functional.pad_target)
+    pad_ch = cfg.resolved_pad_channels
     new_bn: BNState = {}
     step = jnp.clip(num_step, 0, cfg.bn_num_steps - 1)
 
@@ -200,6 +204,7 @@ def apply(
             stride=stride,
             padding=pad,
             impl=cfg.resolved_conv_impl,
+            pad_channels=pad_ch,
         )
         if conv_first:
             out = apply_norm(out, i)
@@ -210,7 +215,9 @@ def apply(
     if not cfg.max_pooling:
         out = F.global_avg_pool2d(out)
     out = out.reshape(out.shape[0], -1)
-    logits = F.linear(out, params["linear.weight"], params["linear.bias"])
+    logits = F.linear(
+        out, params["linear.weight"], params["linear.bias"], pad_channels=pad_ch
+    )
     return logits.astype(jnp.float32), new_bn
 
 

@@ -15,7 +15,7 @@ few_shot_learning_system.py:284) — but re-designed for the MXU:
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +24,61 @@ from jax.ad_checkpoint import checkpoint_name
 
 # Dimension numbers for NHWC activations with HWIO kernels.
 CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+# MXU tiling: the lane (minor-most) dimension of every on-chip tile is 128;
+# the sublane tile depends on dtype (f32 (8, 128), bf16 (16, 128)).
+MXU_LANES = 128
+_SUBLANE_TILE = {jnp.dtype(jnp.bfloat16): 16}
+
+
+def pad_target(c: int, mode: Union[str, int], dtype) -> int:
+    """The compute-time channel count for ``c`` logical channels.
+
+    ``mode`` is a *resolved* ``pad_channels`` value ('off' / 'tile' / int):
+
+    * ``'off'``  — no padding, the logical count;
+    * ``int N``  — round up to the next multiple of N;
+    * ``'tile'`` (what ``pad_channels='auto'`` resolves to on accelerator
+      backends) — round up to the next power of two, floored at the dtype's
+      sublane tile (8 for f32, 16 for bf16) and snapped to multiples of the
+      128-lane width beyond it — e.g. the flagship's 48 filters become 64,
+      a 100-channel layer 128, 200 becomes 256.  These are the shapes the
+      MXU tiles without relayout padding on every GEMM operand.
+
+    Padded values are zeros, which add exact zeros to every contraction
+    partial sum — with the caveat that enlarging the contraction dim can
+    shift the backend's GEMM blocking thresholds and reassociate the float
+    accumulation.  The 'tile' rule's modest pads stay inside one block at
+    the model's sizes (the bit-exactness tests pin this); very large
+    explicit multiples on tiny layers may reassociate at ~1e-6 (see
+    tests/test_pad_channels.py).
+    """
+    if mode == "off":
+        return c
+    if isinstance(mode, int):
+        if mode <= 0:
+            return c
+        return -(-c // mode) * mode
+    if mode != "tile":
+        raise ValueError(
+            f"pad_channels mode must be 'off', 'tile' or an int, got {mode!r}"
+        )
+    floor = _SUBLANE_TILE.get(jnp.dtype(dtype), 8)
+    if c <= floor:
+        return floor
+    if c >= MXU_LANES:
+        return -(-c // MXU_LANES) * MXU_LANES
+    return 1 << (c - 1).bit_length()
+
+
+def _pad_axis(a: jnp.ndarray, axis: int, target: int) -> jnp.ndarray:
+    """Zero-pad one axis of ``a`` up to ``target`` (no-op when equal)."""
+    grow = target - a.shape[axis]
+    if grow == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, grow)
+    return jnp.pad(a, widths)
 
 
 def _im2col(
@@ -60,6 +115,7 @@ def conv2d(
     stride: int,
     padding: int,
     impl: str = "lax",
+    pad_channels: Union[str, int] = "off",
 ) -> jnp.ndarray:
     """2-D convolution, NHWC x HWIO -> NHWC (ref: F.conv2d, meta_...py:89-97).
 
@@ -68,7 +124,8 @@ def conv2d(
     ``impl`` selects the lowering:
 
     * ``"lax"`` — ``lax.conv_general_dilated``, the native conv XLA tiles
-      onto the TPU MXU; the right choice on accelerator backends.
+      onto the TPU MXU; the right choice on accelerator backends when the
+      kernel is shared across the batch.
     * ``"im2col"`` — patches + ``dot_general``. Mathematically identical
       (same contraction, different op), and the backward of a dot_general is
       two more dot_generals, so EVERY derivative order lowers to GEMMs.
@@ -77,11 +134,44 @@ def conv2d(
       with a 14x14 window costs ~89ms where the equivalent GEMM costs ~2ms)
       — the dominant cost of CPU MAML training. Pure lax ops, so it remains
       valid (just not preferred) on TPU.
+    * ``"gemm"`` — the task-batched twin of im2col: patches are flattened to
+      ``(N·Ho·Wo, kh·kw·cin)`` and contracted with the ``(kh·kw·cin, cout)``
+      kernel in ONE explicit ``dot_general``.  Under ``vmap`` over tasks
+      with per-task adapted weights (the MAML inner loop after step 1) the
+      batching rule turns this into a single batched GEMM
+      ``(task, N·Ho·Wo, K) x (task, K, cout)`` per layer — the contraction
+      the MXU runs at peak — where the native conv lowers to a
+      ``feature_group_count=tasks`` grouped conv that XLA handles an order
+      of magnitude below peak.  Every derivative order of a dot_general is
+      again dot_generals, so the whole second-order meta-gradient stays in
+      batched GEMMs.
+
+    ``pad_channels`` (a *resolved* config value: 'off'/'tile'/int — see
+    ``pad_target``) zero-pads cin and cout up to MXU-friendly counts for the
+    compute only: padded input channels contribute exact zeros to the
+    contraction and padded output channels are sliced off before the bias
+    (and therefore before any norm layer), so results are bit-exact with the
+    unpadded op while every GEMM dimension is lane/sublane aligned.
     """
+    kh, kw, cin, cout = w.shape
+    cin_p = pad_target(cin, pad_channels, x.dtype)
+    cout_p = pad_target(cout, pad_channels, x.dtype)
+    if cin_p != cin:
+        x = _pad_axis(x, -1, cin_p)
+        w = _pad_axis(w, 2, cin_p)
+    if cout_p != cout:
+        w = _pad_axis(w, 3, cout_p)
     if impl == "im2col":
-        kh, kw, cin, cout = w.shape
         patches = _im2col(x, kh, kw, stride, padding)
-        out = patches @ w.astype(x.dtype).reshape(kh * kw * cin, cout)
+        out = patches @ w.astype(x.dtype).reshape(kh * kw * cin_p, cout_p)
+    elif impl == "gemm":
+        patches = _im2col(x, kh, kw, stride, padding)
+        n, ho, wo, k = patches.shape
+        out = lax.dot_general(
+            patches.reshape(n * ho * wo, k),
+            w.astype(x.dtype).reshape(k, cout_p),
+            (((1,), (0,)), ((), ())),
+        ).reshape(n, ho, wo, cout_p)
     else:
         out = lax.conv_general_dilated(
             x,
@@ -90,6 +180,8 @@ def conv2d(
             padding=[(padding, padding), (padding, padding)],
             dimension_numbers=CONV_DIMS,
         )
+    if cout_p != cout:
+        out = out[..., :cout]
     if b is not None:
         out = out + b.astype(out.dtype)
     # named for remat_policy='save_conv' (save_only_these_names); a no-op
@@ -97,9 +189,29 @@ def conv2d(
     return checkpoint_name(out, "conv_out")
 
 
-def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray]) -> jnp.ndarray:
-    """Dense layer x @ w + b with w of shape (in, out) (ref: F.linear :141)."""
+def linear(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    b: Optional[jnp.ndarray],
+    pad_channels: Union[str, int] = "off",
+) -> jnp.ndarray:
+    """Dense layer x @ w + b with w of shape (in, out) (ref: F.linear :141).
+
+    ``pad_channels`` compute-pads both GEMM dimensions like ``conv2d``:
+    zero rows contribute nothing to the contraction, padded output columns
+    are sliced off before the bias — bit-exact with the unpadded op.
+    """
+    fin, fout = w.shape
+    fin_p = pad_target(fin, pad_channels, x.dtype)
+    fout_p = pad_target(fout, pad_channels, x.dtype)
+    if fin_p != fin:
+        x = _pad_axis(x, -1, fin_p)
+        w = _pad_axis(w, 0, fin_p)
+    if fout_p != fout:
+        w = _pad_axis(w, 1, fout_p)
     out = x @ w.astype(x.dtype)
+    if fout_p != fout:
+        out = out[..., :fout]
     if b is not None:
         out = out + b.astype(out.dtype)
     return out

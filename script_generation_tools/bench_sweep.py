@@ -2,10 +2,14 @@
 
 Runs ``bench.py`` (fresh process per point, so each gets a clean XLA
 compilation environment) across {compute_dtype} x {use_remat(/remat_policy)}
-and prints a ranked table plus the best point's copy-pasteable env settings.
-Use on real TPU hardware to pick the flagship bench configuration.
+— and, with ``--lowering``, across {conv_impl} x {pad_channels} (the
+task-batched GEMM conv vs the native grouped conv, with and without MXU
+channel padding) — and prints a ranked table plus the best point's
+copy-pasteable env settings. Use on real TPU hardware to pick the flagship
+bench configuration.
 
     python script_generation_tools/bench_sweep.py [--steps 20] [--batch 8]
+    python script_generation_tools/bench_sweep.py --lowering
 """
 
 from __future__ import annotations
@@ -39,40 +43,70 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=20, help="timed steps per point")
     ap.add_argument("--batch", type=int, default=0, help="meta-batch override (0 = bench default)")
     ap.add_argument("--timeout", type=int, default=900, help="per-point timeout (s)")
+    ap.add_argument(
+        "--lowering", action="store_true",
+        help="sweep conv_impl x pad_channels (step lowering) instead of "
+             "compute_dtype x remat",
+    )
     args = ap.parse_args()
 
-    grid = [("false", "full"), ("true", "full"), ("true", "save_conv")]
-    dtypes = ("float32", "bfloat16")
-    if os.environ.get("BENCH_SWEEP_GRID") == "smoke":
-        # CI/smoke mode: one remat point per dtype proves the subprocess
-        # plumbing without six compiles
-        grid = [("false", "full")]
+    # common skips: sweeps rank TRAIN throughput; the epoch-boundary tail
+    # (eval compile + checkpoint write) and the input-pipeline tiers would
+    # only slow every point without changing the ranking
+    base_ov = {
+        "BENCH_TIMED_STEPS": args.steps,
+        "BENCH_SKIP_EPOCH_BOUNDARY": "1",
+        "BENCH_SKIP_INPUT_PIPELINE": "1",
+        "BENCH_SKIP_TELEMETRY_OVERHEAD": "1",
+    }
+    smoke = os.environ.get("BENCH_SWEEP_GRID") == "smoke"
     points = []
-    for dtype in dtypes:
-        for remat, policy in grid:
-            ov = {
-                "BENCH_COMPUTE_DTYPE": dtype,
-                "BENCH_USE_REMAT": remat,
-                "BENCH_REMAT_POLICY": policy,
-                "BENCH_TIMED_STEPS": args.steps,
-                # sweeps rank TRAIN throughput; the epoch-boundary tail
-                # (eval compile + checkpoint write) and the input-pipeline
-                # tiers would only slow every point without changing the
-                # ranking
-                "BENCH_SKIP_EPOCH_BOUNDARY": "1",
-                "BENCH_SKIP_INPUT_PIPELINE": "1",
-                "BENCH_SKIP_TELEMETRY_OVERHEAD": "1",
-            }
-            if args.batch:
-                ov["BENCH_BATCH_SIZE"] = args.batch
-            label = f"remat={remat}" + (f"/{policy}" if remat == "true" else "")
-            print(f"... dtype={dtype} {label}", flush=True)
-            res = run_point(ov, args.timeout)
-            points.append((dtype, label, res, ov))
+    if args.lowering:
+        # the MXU-saturation grid: native grouped conv vs the task-batched
+        # GEMM lowering, each with channel padding off / auto / an explicit
+        # full-lane multiple
+        conv_impls = ("lax", "gemm", "im2col")
+        pads = ("off", "tile", "128")
+        if smoke:
+            conv_impls, pads = ("gemm",), ("off", "tile")
+        for impl in conv_impls:
+            for pad in pads:
+                ov = dict(
+                    base_ov, BENCH_CONV_IMPL=impl, BENCH_PAD_CHANNELS=pad
+                )
+                if args.batch:
+                    ov["BENCH_BATCH_SIZE"] = args.batch
+                label = f"pad={pad}"
+                print(f"... conv_impl={impl} {label}", flush=True)
+                points.append((impl, label, run_point(ov, args.timeout), ov))
+        col = "conv_impl"
+    else:
+        grid = [("false", "full"), ("true", "full"), ("true", "save_conv")]
+        dtypes = ("float32", "bfloat16")
+        if smoke:
+            # CI/smoke mode: one remat point per dtype proves the subprocess
+            # plumbing without six compiles
+            grid = [("false", "full")]
+        for dtype in dtypes:
+            for remat, policy in grid:
+                ov = dict(
+                    base_ov,
+                    BENCH_COMPUTE_DTYPE=dtype,
+                    BENCH_USE_REMAT=remat,
+                    BENCH_REMAT_POLICY=policy,
+                )
+                if args.batch:
+                    ov["BENCH_BATCH_SIZE"] = args.batch
+                label = f"remat={remat}" + (
+                    f"/{policy}" if remat == "true" else ""
+                )
+                print(f"... dtype={dtype} {label}", flush=True)
+                points.append((dtype, label, run_point(ov, args.timeout), ov))
+        col = "dtype"
 
     ok = [p for p in points if "value" in p[2]]
     ok.sort(key=lambda p: -p[2]["value"])
-    print(f"\n{'dtype':<10} {'remat':<16} {'tasks/s/chip':>13}")
+    print(f"\n{col:<10} {'point':<16} {'tasks/s/chip':>13}")
     for d, r, x, _ in ok:
         print(f"{d:<10} {r:<16} {x['value']:>13.3f}")
     for d, r, x, _ in points:

@@ -79,6 +79,20 @@ def test_bench_prints_parsable_json_line():
     assert to["timed_steps"] >= 1
     assert rec["n_chips"] >= 1
     assert rec["dtype"] in ("float32", "bfloat16")
+    # the step lowering is self-describing: conv impl + channel padding
+    # (CPU auto: im2col, padding off)
+    assert rec["conv_impl"] == "im2col"
+    assert rec["pad_channels"] == "off"
+    # donation/aliasing stats of the compiled step: the state is donated
+    # and the executable aliases a non-trivial byte count in place
+    don = rec["donation"]
+    assert don["donate_argnums"] == [0]
+    assert don.get("alias_size_bytes", 0) > 0
+    # per-category HLO cost breakdown: totals plus an op census that names
+    # the contraction ops the lowering produced
+    hc = rec["hlo_cost"]
+    assert hc["flops"] > 0 and hc["bytes_accessed"] > 0
+    assert "hlo_op_counts" in hc and "fusion" in hc["hlo_op_counts"]
     # CPU has no published MXU peak -> mfu is null, never a bogus number
     assert rec["mfu"] is None
     # non-TPU backends run the reduced workload and say so

@@ -113,6 +113,50 @@ def test_classification_mean_std_from_json(tmp_path):
     )
 
 
+def test_resolved_matmul_precision_auto_rules():
+    """Pin the 'auto' resolution rules so a refactor cannot silently change
+    numerics: fp32 compute needs TRUE fp32 MXU multiplies ('highest' — the
+    default single-bf16-pass mode measurably stalls second-order MAML++
+    learning, see RESULTS.md), bf16 compute keeps the native bf16 pass
+    ('default'). Explicit values always pass through untouched."""
+    assert (
+        MAMLConfig(compute_dtype="float32").resolved_matmul_precision
+        == "highest"
+    )
+    assert (
+        MAMLConfig(compute_dtype="bfloat16").resolved_matmul_precision
+        == "default"
+    )
+    # explicit settings win over the auto rule, for either compute dtype
+    for precision in ("default", "high", "highest"):
+        for dtype in ("float32", "bfloat16"):
+            cfg = MAMLConfig(compute_dtype=dtype, matmul_precision=precision)
+            assert cfg.resolved_matmul_precision == precision
+    with pytest.raises(ValueError, match="matmul_precision"):
+        MAMLConfig(matmul_precision="bf16_3x")
+
+
+def test_compilation_cache_dir_default_and_resolution(tmp_path):
+    """'auto' (default) defers to the experiment builder (resolved under the
+    experiment dir); explicit paths and '' pass through to the system."""
+    assert MAMLConfig().compilation_cache_dir == "auto"
+    # the builder resolves 'auto' to <experiment_dir>/xla_cache
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        enable_compilation_cache,
+    )
+
+    prior = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compilation_cache(str(tmp_path / "cache"))
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "cache")
+        enable_compilation_cache("")
+        assert jax.config.jax_compilation_cache_dir is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
+
+
 def test_data_placement_validated():
     """data_placement is checked at config time: bad values, CIFAR (per-image
     RNG augmentation can't vectorize on device), and the missing flat-store

@@ -94,14 +94,109 @@ def test_max_pool_gradient_matches_reduce_window():
     )
 
 
+@pytest.mark.parametrize("stride,padding", [(1, 1), (2, 1), (1, 0), (2, 0)])
+def test_gemm_matches_lax_forward(stride, padding):
+    x = _rand((3, 13, 13, 5), 0)
+    w = _rand((3, 3, 5, 7), 1)
+    b = _rand((7,), 2)
+    out_lax = F.conv2d(x, w, b, stride, padding, impl="lax")
+    out_gemm = F.conv2d(x, w, b, stride, padding, impl="gemm")
+    assert out_lax.shape == out_gemm.shape
+    np.testing.assert_allclose(out_lax, out_gemm, rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_is_task_batched_under_vmap():
+    """The property the TPU lowering depends on: vmap over per-task weights
+    turns the gemm conv into ONE batched dot_general — (task, M, K) x
+    (task, K, cout) — instead of a feature_group_count=tasks grouped conv.
+    Checked both numerically (vs per-task lax convs) and structurally (the
+    jaxpr contains a batched dot_general and no grouped convolution)."""
+    import jax
+
+    tasks = 4
+    x = _rand((tasks, 2, 8, 8, 3), 5)
+    w = _rand((tasks, 3, 3, 3, 6), 6)  # per-task adapted weights
+
+    def gemm_call(xi, wi):
+        return F.conv2d(xi, wi, None, 1, 1, impl="gemm")
+
+    batched = jax.vmap(gemm_call)(x, w)
+    for t in range(tasks):
+        ref = F.conv2d(x[t], w[t], None, 1, 1, impl="lax")
+        np.testing.assert_allclose(
+            np.asarray(batched[t]), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+    jaxpr = str(jax.make_jaxpr(jax.vmap(gemm_call))(x, w))
+    assert "dot_general" in jaxpr
+    assert "conv_general_dilated" not in jaxpr
+    # the contraction is batched over the task axis, not grouped
+    assert "feature_group_count" not in jaxpr
+
+
+def test_gemm_matches_lax_through_train_step(tiny_cfg, synthetic_batch):
+    """The task-batched GEMM lowering must match the native conv through the
+    full second-order outer step: bitwise-equal loss/accuracy is too strict
+    across lowerings, so metrics compare to float tolerance and the
+    meta-gradients to the same tolerances the remat/task-axis equivalence
+    tests use (post-Adam weights amplify ~zero-gradient noise)."""
+    import jax
+    import jax.numpy as jnp
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
+
+    cfg_lax = tiny_cfg.replace(conv_impl="lax")
+    cfg_gemm = tiny_cfg.replace(conv_impl="gemm")
+    state = maml.init_state(cfg_lax)
+    x_s, y_s, x_t, y_t = synthetic_batch(cfg_lax)
+    w = jnp.asarray(
+        msl.loss_weights_for(
+            cfg_lax.number_of_training_steps_per_iter, True, True, 0,
+            cfg_lax.multi_step_loss_num_epochs,
+        )
+    )
+    loss_l, g_l = jax.jit(maml.make_grads_fn(cfg_lax, True))(
+        state, x_s, y_s, x_t, y_t, w
+    )
+    loss_g, g_g = jax.jit(maml.make_grads_fn(cfg_gemm, True))(
+        state, x_s, y_s, x_t, y_t, w
+    )
+    assert float(loss_l) == pytest.approx(float(loss_g), rel=1e-5)
+    for part in ("net", "lslr"):
+        for k in g_l[part]:
+            np.testing.assert_allclose(
+                np.asarray(g_l[part][k]), np.asarray(g_g[part][k]),
+                atol=1e-5, rtol=1e-4, err_msg=f"{part}.{k}",
+            )
+    # metrics through the full step (inner scan + Adam): train and eval
+    step_l = jax.jit(maml.make_train_step(cfg_lax, second_order=True))
+    step_g = jax.jit(maml.make_train_step(cfg_gemm, second_order=True))
+    s_l, m_l = step_l(state, x_s, y_s, x_t, y_t, w, 0.01)
+    s_g, m_g = step_g(state, x_s, y_s, x_t, y_t, w, 0.01)
+    assert float(m_l["loss"]) == pytest.approx(float(m_g["loss"]), rel=1e-5)
+    assert float(m_l["accuracy"]) == pytest.approx(float(m_g["accuracy"]))
+    ev_l = jax.jit(maml.make_eval_step(cfg_lax))
+    ev_g = jax.jit(maml.make_eval_step(cfg_gemm))
+    em_l, p_l = ev_l(s_l, x_s, y_s, x_t, y_t)
+    em_g, p_g = ev_g(s_l, x_s, y_s, x_t, y_t)
+    assert float(em_l["loss"]) == pytest.approx(float(em_g["loss"]), rel=1e-5)
+    assert float(em_l["accuracy"]) == pytest.approx(float(em_g["accuracy"]))
+    np.testing.assert_allclose(
+        np.asarray(p_l), np.asarray(p_g), atol=1e-5, rtol=1e-4
+    )
+
+
 def test_resolved_conv_impl_auto():
     from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 
     cfg = MAMLConfig(dataset_name="omniglot_dataset")
     assert cfg.conv_impl == "auto"
     # tests run on the CPU backend (conftest) -> auto resolves to im2col
+    # regardless of the task-axis mode (the gemm pick is accelerator-only)
     assert cfg.resolved_conv_impl == "im2col"
+    assert cfg.replace(task_axis_mode="map").resolved_conv_impl == "im2col"
     assert cfg.replace(conv_impl="lax").resolved_conv_impl == "lax"
+    assert cfg.replace(conv_impl="gemm").resolved_conv_impl == "gemm"
+    with pytest.raises(ValueError, match="conv_impl"):
+        MAMLConfig(conv_impl="winograd")
 
 
 def test_max_pool_impl_flag_equivalence():

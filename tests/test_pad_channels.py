@@ -1,0 +1,198 @@
+"""Compute-only MXU channel padding (``pad_channels``) bit-exactness.
+
+Padded input channels/rows are zeros that contribute exact zeros to every
+contraction partial sum, and padded output channels are sliced off before
+the bias (and therefore before any norm layer) — so under the shipping
+'tile' rule the padded program must be BIT-exact with the unpadded one, not
+merely allclose: forward, first-order inner gradients, and the second-order
+structure the meta-gradient differentiates, in f32 and bf16, through every
+conv lowering and through the full backbone (conv + batch-norm + linear
+head).  The one caveat — pinned by its own test below — is that a very
+large explicit multiple on a tiny layer can grow the contraction dim past
+the backend's GEMM blocking threshold and reassociate the accumulation at
+the ~1e-6 level; the tile rule's modest pads stay inside one block.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.models import vgg
+from howtotrainyourmamlpytorch_tpu.ops import functional as F
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    ).astype(dtype)
+
+
+def test_pad_target_tile_rule():
+    """The documented 'tile' quantization: next power of two, floored at the
+    dtype sublane tile (8 f32 / 16 bf16), multiples of the 128-lane width
+    beyond it — the flagship's 48 filters compute as 64."""
+    f32, bf16 = jnp.float32, jnp.bfloat16
+    assert F.pad_target(48, "tile", f32) == 64
+    assert F.pad_target(48, "tile", bf16) == 64
+    assert F.pad_target(3, "tile", f32) == 8
+    assert F.pad_target(3, "tile", bf16) == 16
+    assert F.pad_target(64, "tile", f32) == 64
+    assert F.pad_target(100, "tile", f32) == 128
+    assert F.pad_target(129, "tile", f32) == 256
+    assert F.pad_target(128, "tile", bf16) == 128
+    # explicit integer multiple and off
+    assert F.pad_target(48, 32, f32) == 64
+    assert F.pad_target(48, "off", f32) == 48
+    with pytest.raises(ValueError, match="pad_channels"):
+        F.pad_target(48, "bogus", f32)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("impl", ["lax", "im2col", "gemm"])
+@pytest.mark.parametrize("mode", ["tile", 8])
+def test_conv2d_padded_bit_exact(dtype, impl, mode):
+    x = _rand((3, 9, 9, 5), 0, dtype)
+    w = _rand((3, 3, 5, 7), 1)
+    b = _rand((7,), 2)
+    base = F.conv2d(x, w, b, 1, 1, impl=impl, pad_channels="off")
+    padded = F.conv2d(x, w, b, 1, 1, impl=impl, pad_channels=mode)
+    assert base.shape == padded.shape
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(padded))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_linear_padded_bit_exact(dtype):
+    x = _rand((6, 48), 3, dtype)
+    w = _rand((48, 5), 4)
+    b = _rand((5,), 5)
+    base = F.linear(x, w, b, pad_channels="off")
+    padded = F.linear(x, w, b, pad_channels="tile")
+    assert base.shape == padded.shape
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(padded))
+
+
+def test_oversized_explicit_multiple_is_allclose():
+    """Padding a 5-channel conv to a 32-multiple grows the contraction dim
+    45 -> 288, which can cross the backend GEMM's K-blocking threshold and
+    reassociate the float accumulation (observed 4.5e-6 on the threaded
+    XLA:CPU backend) — equivalent to float noise, not bit-exact. The tile
+    rule never pads this aggressively relative to the layer size."""
+    x = _rand((3, 9, 9, 5), 0)
+    w = _rand((3, 3, 5, 7), 1)
+    base = F.conv2d(x, w, None, 1, 1, impl="gemm", pad_channels="off")
+    padded = F.conv2d(x, w, None, 1, 1, impl="gemm", pad_channels=32)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(padded), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("impl", ["lax", "im2col", "gemm"])
+def test_conv2d_padded_gradients_bit_exact(impl):
+    """First- and second-order derivatives of the padded op vs unpadded —
+    the orders the bi-level step actually differentiates."""
+    x = _rand((2, 8, 8, 4), 6)
+    w = _rand((3, 3, 4, 6), 7)
+
+    def first(pad):
+        return jax.grad(
+            lambda w_: jnp.sum(
+                F.conv2d(x, w_, None, 1, 1, impl=impl, pad_channels=pad) ** 2
+            )
+        )(w)
+
+    np.testing.assert_array_equal(
+        np.asarray(first("off")), np.asarray(first("tile"))
+    )
+
+    def second(pad):
+        def f(w_):
+            g = jax.grad(
+                lambda w2: jnp.sum(
+                    F.conv2d(x, w2, None, 1, 1, impl=impl, pad_channels=pad)
+                    ** 2
+                )
+            )(w_)
+            return jnp.sum(jnp.tanh(g))
+
+        return jax.grad(f)(w)
+
+    np.testing.assert_array_equal(
+        np.asarray(second("off")), np.asarray(second("tile"))
+    )
+
+
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+def test_backbone_padded_bit_exact_through_bn(tiny_cfg, dtype_name):
+    """The full backbone — conv, slice-back, batch-norm on logical channels,
+    linear head — padded vs unpadded: logits and BN running stats must be
+    bit-identical (the slice-back happens BEFORE the norm sees anything)."""
+    cfg_off = tiny_cfg.replace(pad_channels="off", compute_dtype=dtype_name)
+    cfg_pad = tiny_cfg.replace(pad_channels="tile", compute_dtype=dtype_name)
+    assert cfg_pad.resolved_pad_channels == "tile"
+    params, bn = vgg.init(cfg_off, jax.random.PRNGKey(0))
+    x = np.random.RandomState(1).randn(6, *cfg_off.im_shape).astype(np.float32)
+    out_off, bn_off = vgg.apply(cfg_off, params, bn, x, 0, training=True)
+    out_pad, bn_pad = vgg.apply(cfg_pad, params, bn, x, 0, training=True)
+    np.testing.assert_array_equal(np.asarray(out_off), np.asarray(out_pad))
+    for k in bn_off:
+        np.testing.assert_array_equal(
+            np.asarray(bn_off[k]), np.asarray(bn_pad[k]), err_msg=k
+        )
+
+
+def test_train_step_padded_metrics_exact_grads_close(tiny_cfg, synthetic_batch):
+    """One full second-order outer step with tile-rule channel padding on vs
+    off: loss/accuracy bit-identical, meta-gradients equal to float noise.
+    Compared at the gradient level per the repo convention (make_grads_fn):
+    post-Adam weights amplify float-reordering noise on ~zero-gradient
+    params (a conv bias under batch-norm) into O(lr) differences."""
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
+
+    cfg_off = tiny_cfg.replace(pad_channels="off")
+    cfg_pad = tiny_cfg.replace(pad_channels="tile")
+    x_s, y_s, x_t, y_t = synthetic_batch(cfg_off)
+    w = jnp.asarray(
+        msl.loss_weights_for(
+            cfg_off.number_of_training_steps_per_iter, True, True, 0,
+            cfg_off.multi_step_loss_num_epochs,
+        )
+    )
+    s_off = maml.init_state(cfg_off)
+    s_pad = maml.init_state(cfg_pad)
+    step_off = jax.jit(maml.make_train_step(cfg_off, second_order=True))
+    step_pad = jax.jit(maml.make_train_step(cfg_pad, second_order=True))
+    _, m_off = step_off(s_off, x_s, y_s, x_t, y_t, w, 0.01)
+    _, m_pad = step_pad(s_pad, x_s, y_s, x_t, y_t, w, 0.01)
+    assert float(m_off["loss"]) == float(m_pad["loss"])
+    assert float(m_off["accuracy"]) == float(m_pad["accuracy"])
+    loss_off, g_off = jax.jit(maml.make_grads_fn(cfg_off, True))(
+        s_off, x_s, y_s, x_t, y_t, w
+    )
+    loss_pad, g_pad = jax.jit(maml.make_grads_fn(cfg_pad, True))(
+        s_pad, x_s, y_s, x_t, y_t, w
+    )
+    assert float(loss_off) == pytest.approx(float(loss_pad), rel=1e-6)
+    for part in ("net", "lslr"):
+        for k in g_off[part]:
+            np.testing.assert_allclose(
+                np.asarray(g_off[part][k]), np.asarray(g_pad[part][k]),
+                atol=1e-5, rtol=1e-4, err_msg=f"{part}.{k}",
+            )
+
+
+def test_pad_channels_config_validation_and_resolution():
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.pad_channels == "auto"
+    # tests run on the CPU backend (conftest) -> auto resolves to off
+    assert cfg.resolved_pad_channels == "off"
+    assert cfg.replace(pad_channels=64).resolved_pad_channels == 64
+    assert cfg.replace(pad_channels="off").resolved_pad_channels == "off"
+    assert cfg.replace(pad_channels="tile").resolved_pad_channels == "tile"
+    # JSON configs may carry the multiple as a string
+    assert MAMLConfig(pad_channels="64").pad_channels == 64
+    with pytest.raises(ValueError, match="pad_channels"):
+        MAMLConfig(pad_channels="sometimes")
+    with pytest.raises(ValueError, match="pad_channels"):
+        MAMLConfig(pad_channels=-8)
