@@ -406,35 +406,36 @@ def _measure_input_pipeline(cfg, reduced: bool) -> dict | None:
         shutil.rmtree(root, ignore_errors=True)
 
 
-def _measure_telemetry_overhead(
-    cfg, mesh, batch, weights, off_ms_per_step: float, reduced: bool
+def _measure_step_overhead(
+    cfg, mesh, batch, weights, off_ms_per_step: float, reduced: bool,
+    *, name: str, cfg_override: dict, on_ms_key: str, steps_env: str,
 ) -> dict | None:
-    """Step-time cost of the on-device training-dynamics collection
-    (``telemetry_level='dynamics'`` vs. off), so the telemetry trajectory
-    is tracked in the bench line like ``epoch_boundary``.
+    """Step-time cost of an optional in-step feature (``cfg_override``
+    applied to the flagship config) vs. the plain step, tracked in the
+    bench line like ``epoch_boundary``.
 
     The 'off' arm IS the main timed loop (the flagship step is built with
-    telemetry off); only the dynamics arm is compiled and timed here, with
-    the same sync protocol. Informational — never part of baseline
-    comparability. Best-effort: any failure returns None with a stderr
-    note rather than killing the bench line.
+    the feature off); only the feature arm is compiled and timed here,
+    with the same donation and tunnel-proof sync protocol — one harness
+    for every overhead metric, so a fix to the timing protocol cannot
+    leave two measurements disagreeing. Informational — never part of
+    baseline comparability. Best-effort: any failure returns None with a
+    stderr note rather than killing the bench line.
     """
     import jax
 
     from howtotrainyourmamlpytorch_tpu.core import maml
 
-    steps_n = int(
-        os.environ.get("BENCH_TELEMETRY_STEPS", "2" if reduced else "10")
-    )
+    steps_n = int(os.environ.get(steps_env, "2" if reduced else "10"))
     try:
-        tcfg = cfg.replace(telemetry_level="dynamics")
-        state = maml.init_state(tcfg)
+        fcfg = cfg.replace(**cfg_override)
+        state = maml.init_state(fcfg)
         if mesh is not None:
             from howtotrainyourmamlpytorch_tpu.parallel import mesh as mesh_lib
 
             state = mesh_lib.replicate_state(mesh, state)
         step = jax.jit(
-            maml.make_train_step(tcfg, second_order=True),
+            maml.make_train_step(fcfg, second_order=True),
             donate_argnums=maml.TRAIN_DONATE,
         )
         x_s, y_s, x_t, y_t = batch
@@ -446,21 +447,51 @@ def _measure_telemetry_overhead(
             state, m = step(state, x_s, y_s, x_t, y_t, weights, 1e-3)
         jax.block_until_ready(state.net)
         float(np.asarray(m["loss"]))  # tunnel-proof sync (see sync())
-        dyn_ms = (time.perf_counter() - start) / steps_n * 1e3
+        on_ms = (time.perf_counter() - start) / steps_n * 1e3
         return {
             "off_ms_per_step": round(off_ms_per_step, 3),
-            "dynamics_ms_per_step": round(dyn_ms, 3),
+            on_ms_key: round(on_ms, 3),
             "overhead_pct": (
-                round((dyn_ms - off_ms_per_step) / off_ms_per_step * 100, 2)
+                round((on_ms - off_ms_per_step) / off_ms_per_step * 100, 2)
                 if off_ms_per_step > 0
                 else None
             ),
             "timed_steps": steps_n,
         }
     except Exception as e:  # noqa: BLE001 - informational metric only
-        print(f"bench: telemetry_overhead measurement failed ({e!r})",
-              file=sys.stderr)
+        print(f"bench: {name} measurement failed ({e!r})", file=sys.stderr)
         return None
+
+
+def _measure_telemetry_overhead(
+    cfg, mesh, batch, weights, off_ms_per_step: float, reduced: bool
+) -> dict | None:
+    """On-device training-dynamics collection cost
+    (``telemetry_level='dynamics'`` vs. off)."""
+    return _measure_step_overhead(
+        cfg, mesh, batch, weights, off_ms_per_step, reduced,
+        name="telemetry_overhead",
+        cfg_override={"telemetry_level": "dynamics"},
+        on_ms_key="dynamics_ms_per_step",
+        steps_env="BENCH_TELEMETRY_STEPS",
+    )
+
+
+def _measure_health_overhead(
+    cfg, mesh, batch, weights, off_ms_per_step: float, reduced: bool
+) -> dict | None:
+    """On-device anomaly-probe cost (``health_level='monitor'`` vs. off) —
+    the training-health monitor's device-side half. The probes are a
+    handful of scalar reductions over values the step already holds, so
+    this should stay near zero; a regression here means the probe lowering
+    grew real work."""
+    return _measure_step_overhead(
+        cfg, mesh, batch, weights, off_ms_per_step, reduced,
+        name="health_overhead",
+        cfg_override={"health_level": "monitor"},
+        on_ms_key="monitor_ms_per_step",
+        steps_env="BENCH_HEALTH_STEPS",
+    )
 
 
 # BENCH_* env vars that change WHAT is measured (workload shapes or
@@ -728,6 +759,15 @@ def main() -> None:
             elapsed / timed_steps * 1e3, reduced,
         )
 
+    # on-device anomaly-probe cost (health_level='monitor' vs off): null
+    # when skipped or unmeasurable
+    health_overhead = None
+    if os.environ.get("BENCH_SKIP_HEALTH_OVERHEAD") != "1":
+        health_overhead = _measure_health_overhead(
+            cfg, mesh, (x_s, y_s, x_t, y_t), weights,
+            elapsed / timed_steps * 1e3, reduced,
+        )
+
     peak = _peak_flops(device_kind, cfg.compute_dtype)
     # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
     # recompute) over peak. hfu: *executed* FLOPs per XLA's cost analysis of
@@ -797,6 +837,9 @@ def main() -> None:
         # step time with telemetry_level='dynamics' vs off (informational —
         # not part of baseline comparability)
         "telemetry_overhead": telemetry_overhead,
+        # step time with health_level='monitor' vs off (informational —
+        # not part of baseline comparability)
+        "health_overhead": health_overhead,
         # pinned workload descriptor: makes round-over-round lines
         # self-describing so a knob-default change can never silently turn
         # the driver series into an apples-to-oranges trend
@@ -853,7 +896,7 @@ def main() -> None:
             if k not in ("vs_baseline", "baseline_backend",
                          "baseline_refreshed", "epoch_boundary",
                          "input_pipeline", "telemetry_overhead",
-                         "hlo_cost", "donation")
+                         "health_overhead", "hlo_cost", "donation")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

@@ -7,6 +7,16 @@ Usage:
 
 Any MAMLConfig field can be overridden on the command line; a JSON config
 file (reference format) supplies the rest.
+
+The ``inspect`` subcommand is the telemetry reader
+(tools/telemetry_cli.py): summarize / tail / diff / validate a run's
+``logs/telemetry.jsonl`` —
+
+    python -m howtotrainyourmamlpytorch_tpu.cli inspect summary LOG
+    python -m howtotrainyourmamlpytorch_tpu.cli inspect diff LOG_A LOG_B
+
+It is dispatched before any jax-importing module loads, so inspection
+works on a machine with nothing but the repo and numpy installed.
 """
 
 from __future__ import annotations
@@ -14,13 +24,9 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import sys
 
 from .config import MAMLConfig, _coerce_bool
-from .data.loader import MetaLearningDataLoader
-from .experiment.builder import ExperimentBuilder
-from .parallel.distributed import initialize_distributed
-from .utils.dataset_tools import maybe_unzip_dataset
-from .experiment.system import MAMLFewShotClassifier
 
 
 def get_args(argv=None) -> MAMLConfig:
@@ -68,7 +74,20 @@ def get_args(argv=None) -> MAMLConfig:
 
 
 def main(argv=None):
-    cfg = get_args(argv)
+    args = sys.argv[1:] if argv is None else list(argv)
+    if args and args[0] == "inspect":
+        # telemetry inspect/diff: pure stdlib + numpy — dispatched before
+        # the jax-heavy training imports below
+        from .tools.telemetry_cli import main as telemetry_main
+
+        raise SystemExit(telemetry_main(args[1:]))
+    from .data.loader import MetaLearningDataLoader
+    from .experiment.builder import ExperimentBuilder
+    from .experiment.system import MAMLFewShotClassifier
+    from .parallel.distributed import initialize_distributed
+    from .utils.dataset_tools import maybe_unzip_dataset
+
+    cfg = get_args(args)
     initialize_distributed()  # no-op unless a multi-host coordinator is set
     import jax
 

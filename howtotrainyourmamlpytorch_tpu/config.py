@@ -233,6 +233,54 @@ class MAMLConfig:
     # loop reports no progress for this many seconds (multihost hang
     # debugging: the stack names the blocking collective). 0 disables.
     watchdog_timeout_s: float = 0.0
+    # --- training-health monitor (telemetry/health.py, flight_recorder.py) -
+    # 'monitor' adds a handful of on-device health reductions to the train
+    # step — global meta-gradient L2 norm (pre-clip), non-finite grad-element
+    # count, update and post-update parameter norms — riding back with the
+    # metrics (zero extra device syncs; the traced training math is
+    # untouched, so loss/accuracy/params stay bit-identical, tested). The
+    # host-side AnomalyDetector evaluates them one dispatch behind the device
+    # (the one-step-lag sync has already materialised the previous dispatch's
+    # outputs, so detection adds no blocking), flags non-finite grads/loss
+    # always and EMA-relative loss/grad-norm spikes per the knobs below, and
+    # triggers the flight recorder. 'halt' additionally ESCALATES: once
+    # health_patience anomalous iterations have been observed, the builder
+    # writes a resumable emergency checkpoint (train_model_emergency) plus a
+    # forensic incident dump and raises TrainingDivergedError instead of
+    # training on garbage. 'off' (default) traces the exact pre-probe
+    # program.
+    health_level: str = "off"  # 'off' | 'monitor' | 'halt'
+    # EMA-relative spike rules (0 disables a rule; non-finite rules are
+    # always armed while probes are on): anomaly when
+    # value > factor * EMA(value), after anomaly_warmup_steps observations
+    anomaly_loss_spike_factor: float = 10.0
+    anomaly_grad_spike_factor: float = 10.0
+    # absolute pre-clip global grad-norm ceiling (0 disables): unlike the
+    # EMA-relative spike rule this needs no warmup and catches a run whose
+    # gradients are ALREADY huge at step 0
+    health_grad_norm_limit: float = 0.0
+    # at health_level='halt': anomalous iterations tolerated before the
+    # builder halts the run (>=1; anomalies during warmup count too — the
+    # non-finite rules are always armed)
+    health_patience: int = 1
+    # absolute ||update|| / ||params|| ceiling (0 disables): catches LR/LSLR
+    # blowups that move parameters by a large fraction of their norm in one
+    # outer step
+    anomaly_update_ratio_max: float = 0.0
+    anomaly_ema_beta: float = 0.98  # EMA decay for the spike baselines
+    anomaly_warmup_steps: int = 20  # observations before spike rules arm
+    # per-reason re-report suppression (steps): a run wedged at NaN emits
+    # one anomaly record per reason per window, not one per step
+    anomaly_cooldown_steps: int = 200
+    # flight recorder: ring buffer of the last N per-step health entries +
+    # builder events (host-side, a few floats per step); anomalies and
+    # watchdog stalls dump it with a full state checkpoint to
+    # logs/incidents/. 0 disables the recorder (anomaly records still go to
+    # the telemetry log).
+    flight_recorder_steps: int = 256
+    # per-run cap on anomaly-triggered incident dumps (each carries an
+    # orbax state checkpoint — params + LSLR + BN + Adam moments)
+    max_state_dumps: int = 3
     # persistent XLA compilation cache: resumed runs (and repeated runs of
     # the same config) skip the 20-40s TPU compile of the train/eval steps.
     # 'auto' (default) => <experiment_dir>/xla_cache, resolved by the
@@ -357,6 +405,33 @@ class MAMLConfig:
                 f"telemetry_level must be 'off', 'scalars' or 'dynamics', "
                 f"got {self.telemetry_level!r}"
             )
+        if self.health_level not in ("off", "monitor", "halt"):
+            raise ValueError(
+                f"health_level must be 'off', 'monitor' or 'halt', got "
+                f"{self.health_level!r}"
+            )
+        if self.health_patience < 1:
+            raise ValueError(
+                f"health_patience must be >= 1, got {self.health_patience}"
+            )
+        for knob in ("anomaly_loss_spike_factor", "anomaly_grad_spike_factor",
+                     "anomaly_update_ratio_max", "health_grad_norm_limit"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob} must be >= 0 (0 disables the rule), got "
+                    f"{getattr(self, knob)}"
+                )
+        if not (0.0 < self.anomaly_ema_beta < 1.0):
+            raise ValueError(
+                f"anomaly_ema_beta must be in (0, 1), got "
+                f"{self.anomaly_ema_beta}"
+            )
+        for knob in ("anomaly_warmup_steps", "anomaly_cooldown_steps",
+                     "flight_recorder_steps", "max_state_dumps"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob} must be >= 0, got {getattr(self, knob)}"
+                )
         if self.watchdog_timeout_s < 0:
             raise ValueError(
                 f"watchdog_timeout_s must be >= 0 (0 disables), got "

@@ -334,6 +334,45 @@ def make_grads_fn(cfg: MAMLConfig, second_order: bool):
     return grads_fn
 
 
+def _tree_sq_sum(tree) -> jnp.ndarray:
+    """Sum of squares over every leaf, accumulated in f32 (bf16 configs
+    would overflow/underflow a same-dtype reduction)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(
+        jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves
+    )
+
+
+def _tree_nonfinite_count(tree) -> jnp.ndarray:
+    """Number of non-finite elements across every leaf (int32)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(
+        jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32) for leaf in leaves
+    )
+
+
+def _health_probes(loss, raw_grads, updates, new_trainable):
+    """The on-device anomaly probes (``health_level != 'off'``).
+
+    A handful of scalar reductions over values the step already holds —
+    the PRE-clip meta-gradients (an explosion must be visible before the
+    ±10 clamp hides it), the post-LR updates and the post-update
+    parameters — returned under ``metrics['health']`` so they ride back
+    with the metrics like the dynamics stacks: zero extra device syncs,
+    and the training math is untouched (probes are pure reads of step
+    outputs, never inputs to the loss/update graph). The host-side
+    ``telemetry.health.AnomalyDetector`` consumes these one dispatch
+    behind the device.
+    """
+    return {
+        "loss": loss.astype(jnp.float32),
+        "grad_norm": jnp.sqrt(_tree_sq_sum(raw_grads)),
+        "nonfinite_grads": _tree_nonfinite_count(raw_grads),
+        "update_norm": jnp.sqrt(_tree_sq_sum(updates)),
+        "param_norm": jnp.sqrt(_tree_sq_sum(new_trainable)),
+    }
+
+
 def _decode_prelude(cfg: MAMLConfig, decode_uint8: Optional[bool]):
     """The in-jit uint8 decode for ``data_placement='uint8_stream'`` batches
     (None => follow the config), or None when batches arrive as float32."""
@@ -360,9 +399,16 @@ def make_train_step(
     existing scan), the post-update LSLR vectors, and the MSL weight
     vector. It rides back with the metrics, so collection adds zero extra
     device syncs; with telemetry off the traced program is unchanged.
+
+    ``health_level != 'off'`` adds a ``metrics['health']`` dict under the
+    same zero-extra-syncs contract: the scalar anomaly probes of
+    ``_health_probes`` (pre-clip meta-gradient norm, non-finite grad
+    count, update/param norms), consumed one dispatch behind the device by
+    the host-side anomaly detector (telemetry/health.py).
     """
     num_steps = cfg.number_of_training_steps_per_iter
     collect = cfg.telemetry_level == "dynamics"
+    probe = cfg.health_level != "off"
     learner = _task_learner(cfg, num_steps, second_order, collect)
     decode = _decode_prelude(cfg, decode_uint8)
 
@@ -386,6 +432,7 @@ def make_train_step(
             learner, state, x_s, y_s, x_t, y_t, loss_weights,
             cfg.task_axis_mode,
         )
+        raw_grads = grads  # pre-clip view for the health probes
         if cfg.clip_grads:
             # elementwise clamp to ±10, net params only
             # (few_shot_learning_system.py:332-335)
@@ -405,6 +452,10 @@ def make_train_step(
             opt=new_opt,
         )
         metrics = {"loss": loss, "accuracy": jnp.mean(correct)}
+        if probe:
+            metrics["health"] = _health_probes(
+                loss, raw_grads, updates, new_trainable
+            )
         if collect:
             # mean over the (leading) task axis keeps the payload tiny:
             # a handful of (num_steps,) vectors per dispatch

@@ -22,6 +22,7 @@ Re-implementation of the reference's ``ExperimentBuilder``
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 import time
@@ -30,7 +31,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..config import MAMLConfig
-from ..telemetry import Telemetry, Watchdog
+from ..telemetry import FlightRecorder, HealthMonitor, Telemetry, Watchdog
 from ..utils.profiling import StepTimer, TraceWindow
 from ..utils.storage import (
     build_experiment_folder,
@@ -167,7 +168,36 @@ class ExperimentBuilder:
             start_epoch=int(self.start_epoch),
             process_index=jax.process_index(),
             process_count=jax.process_count(),
+            # the full config snapshot: what `telemetry_cli diff` diffs so
+            # two runs' logs explain their own divergence
+            config=dataclasses.asdict(cfg),
         )
+        # training-health monitor: host-side ring of recent step health
+        # (flight recorder) + anomaly detection over the on-device probes
+        # (health_level='monitor'|'halt'), dumping ring + state to
+        # logs/incidents/ on a trigger — see telemetry/health.py
+        self.flight_recorder = None
+        if cfg.flight_recorder_steps > 0:
+            self.flight_recorder = FlightRecorder(
+                cfg.flight_recorder_steps,
+                os.path.join(self.logs_filepath, "incidents"),
+                max_state_dumps=cfg.max_state_dumps,
+                cooldown_steps=cfg.anomaly_cooldown_steps,
+                is_primary=self.is_primary,
+            )
+        self.health_monitor = None
+        if cfg.health_level != "off":
+            self.health_monitor = HealthMonitor(
+                cfg,
+                telemetry=self.telemetry,
+                recorder=self.flight_recorder,
+                # multihost: ring + manifest only — a collective orbax save
+                # from the anomaly path could deadlock a wedged mesh
+                state_dump_fn=(
+                    None if self.model.multihost
+                    else self._dump_state_for_incident
+                ),
+            )
         # on-device dynamics stacks (telemetry_level='dynamics') buffered as
         # DEVICE arrays per dispatch; converted + flushed at epoch-summary
         # time so collection never adds a host sync to the hot loop
@@ -289,11 +319,145 @@ class ExperimentBuilder:
             flush=True,
         )
         if self.telemetry.enabled:
-            self.telemetry.event("watchdog_stall", **record)
+            # since schema v2 the stall record also carries the flight-
+            # recorder tail and the last evaluated health entry (when the
+            # monitor is on): a hang and a divergence preceding it are
+            # diagnosable from ONE record, without cross-referencing the
+            # incident directory
+            context = {}
+            if self.flight_recorder is not None:
+                context["recorder_tail"] = self.flight_recorder.snapshot()[-8:]
+            if self.health_monitor is not None:
+                context["last_health"] = self.health_monitor.last_entry
+            self.telemetry.event("watchdog_stall", **record, **context)
         else:
             for name, stack in record["stacks"].items():
                 print(f"[watchdog] thread {name}:\n{stack}",
                       file=sys.stderr, flush=True)
+        if self.flight_recorder is not None:
+            # ring + manifest only: no state checkpoint from the watchdog
+            # thread — fetching device state while the device is the thing
+            # that is wedged would hang the diagnostic itself. force=True:
+            # the recorder cooldown is reason-agnostic, and an anomaly dump
+            # moments before the hang (divergence-then-wedge) must not
+            # swallow the stall incident; the watchdog itself fires once
+            # per stall, so this cannot spam.
+            try:
+                path = self.flight_recorder.dump(
+                    "watchdog_stall",
+                    int(self.state["current_iter"]),
+                    details={
+                        "stage": record["stage"],
+                        "seconds_since_progress":
+                            record["seconds_since_progress"],
+                        "beat_count": record["beat_count"],
+                    },
+                    state_dump_fn=None,
+                    force=True,
+                )
+            except Exception as e:  # noqa: BLE001 - best-effort: an I/O
+                # failure in the diagnostic must not crash the watchdog
+                # thread before the stacks above reach the log
+                print(f"[watchdog] ring dump failed: {e!r}",
+                      file=sys.stderr, flush=True)
+                path = None
+            if path is not None:
+                print(f"[watchdog] flight-recorder ring dumped to {path}",
+                      file=sys.stderr, flush=True)
+                self.telemetry.event(
+                    "incident",
+                    iter=int(self.state["current_iter"]),
+                    reason="watchdog_stall",
+                    path=path,
+                )
+
+    def _pop_health(self, losses: Dict) -> bool:
+        """Divert the on-device health probes out of the metric dict (never
+        into the reference-compatible CSV) and hand them to the monitor,
+        which evaluates them one dispatch behind (see telemetry/health.py).
+        Popped unconditionally: a probes-on config must not leak the dict
+        into the epoch summary even if the monitor is absent.
+
+        Returns True when the monitor latched a halt decision. The CALLER
+        escalates, after advancing ``current_iter`` past the dispatch it
+        just enqueued: the emergency checkpoint fetches ``model.state``
+        (which contains that dispatch's updates) and must pair it with a
+        counter that covers them, or a resumed run would re-apply the
+        in-flight update(s) and skew the LR/MSL schedule."""
+        health = losses.pop("health", None)
+        if health is not None and self.health_monitor is not None:
+            self.health_monitor.observe(
+                int(self.state["current_iter"]), health
+            )
+            return self.health_monitor.should_halt
+        return False
+
+    def _halt_for_divergence(self):
+        """The ``health_level='halt'`` escalation: drain the monitor, write
+        a RESUMABLE emergency checkpoint (``train_model_emergency`` — the
+        divergent state itself, loadable via
+        ``model.load_model(dir, 'emergency')`` for postmortem or a rolled-
+        back restart) plus a final forced incident dump, then raise
+        ``TrainingDivergedError`` instead of training on garbage. Multihost
+        runs reach this point on every host at the same iteration (the
+        probes reduce replicated metrics), so the collective checkpoint
+        save is safe; only the primary writes the ring dump."""
+        from ..telemetry import TrainingDivergedError
+        from . import checkpoint as ckpt
+
+        mon = self.health_monitor
+        mon.flush()  # the deferred last dispatch: we're stopping anyway
+        anomaly = mon.halt_anomaly or {}
+        it = int(anomaly.get("iter", self.state["current_iter"]))
+        self._beat("emergency_checkpoint")
+        ckpt_path = self.model.save_model(
+            self.saved_models_filepath, "emergency", self.state,
+        )
+        ckpt.wait_for_pending()  # on disk before the raise, not after
+        dump_dir = None
+        if self.flight_recorder is not None:
+            try:
+                dump_dir = self.flight_recorder.dump(
+                    "halt",
+                    it,
+                    details={
+                        "anomaly": anomaly,
+                        "anomalous_iterations":
+                            mon.detector.anomalous_iterations,
+                        "patience": mon.patience,
+                        "emergency_checkpoint": ckpt_path,
+                    },
+                    state_dump_fn=mon.state_dump_fn,
+                    force=True,  # a routine anomaly dump moments earlier
+                )                # must not cooldown-swallow the forensics
+            except Exception as e:  # noqa: BLE001 - the dump is best-effort
+                # garnish: TrainingDivergedError (with the emergency
+                # checkpoint already on disk) must still be the exception
+                # the caller sees, not a disk-full OSError
+                print(f"[health] halt incident dump failed: {e!r}",
+                      file=sys.stderr, flush=True)
+            if dump_dir is not None:
+                self.telemetry.event(
+                    "incident", iter=it, reason="halt", path=dump_dir,
+                )
+        msg = (
+            f"training diverged: {anomaly.get('reason', 'anomaly')} at "
+            f"iter {it} ({mon.detector.anomalous_iterations} anomalous "
+            f"iteration(s) >= health_patience={mon.patience}); emergency "
+            f"checkpoint: {ckpt_path}, incident dump: {dump_dir}"
+        )
+        print(f"[health] HALT — {msg}", file=sys.stderr, flush=True)
+        raise TrainingDivergedError(
+            msg, iter_at_halt=it, dump_dir=dump_dir,
+            checkpoint_path=ckpt_path,
+        )
+
+    def _dump_state_for_incident(self, dump_dir: str) -> None:
+        """State-checkpoint hook the flight recorder calls inside an
+        anomaly incident dump (single-host; the monitor passes None on
+        multihost meshes)."""
+        self._beat("incident_state_dump")
+        self.model.dump_state(dump_dir, self.state)
 
     def _pop_dynamics(self, losses: Dict, n_iters: int):
         """Divert the on-device dynamics stacks (still device arrays) out of
@@ -333,12 +497,18 @@ class ExperimentBuilder:
         self._beat("train_dispatch")
         losses = self.model.run_train_iter(train_sample, epoch=epoch_idx)
         self._pop_dynamics(losses, 1)
+        halt = self._pop_health(losses)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += 1
         # with the model's one-step-lag sync, tick intervals equal device
         # step time at steady state (one step in flight, host waits on k-1)
         self.step_timer.tick()
         self._steps_this_run += 1
+        if halt:
+            # raised on the train-loop thread, so the loop unwinds cleanly;
+            # deferred past the increment so the emergency checkpoint's
+            # counter covers the update already in model.state (resumable)
+            self._halt_for_divergence()
 
     def train_iterations(self, train_samples, epoch_idx):
         """Chunked variant: len(train_samples) updates in ONE device
@@ -353,6 +523,7 @@ class ExperimentBuilder:
         self._beat("train_dispatch")
         losses = self.model.run_train_iters(list(train_samples), epoch=epoch_idx)
         self._pop_dynamics(losses, len(train_samples))
+        halt = self._pop_health(losses)
         # ONE accumulation per chunk: device metrics arrive (k,)-stacked and
         # the epoch summary flattens them — per-iteration slicing here would
         # issue 2k tiny device programs per chunk (see run_train_iters)
@@ -360,6 +531,8 @@ class ExperimentBuilder:
         self.state["current_iter"] += len(train_samples)
         self.step_timer.tick()
         self._steps_this_run += len(train_samples)
+        if halt:
+            self._halt_for_divergence()
 
     def _sync_device(self):
         """Drain in-flight dispatches (trace-window stop barrier)."""
@@ -515,6 +688,25 @@ class ExperimentBuilder:
                 **self.model.device_memory_stats(),
             )
         self._flush_dynamics()
+        # health probes still deferred from the epoch's last dispatch: the
+        # summary above already synced the device, so this costs nothing
+        if self.health_monitor is not None:
+            self.health_monitor.flush()
+            if self.health_monitor.should_halt:
+                self._halt_for_divergence()
+        if self.flight_recorder is not None:
+            # epoch marker in the ring: a dumped ring shows where in the
+            # run its steps sat
+            self.flight_recorder.note_event(
+                "epoch",
+                epoch=int(self.epoch),
+                **{
+                    k: float(epoch_summary[k])
+                    for k in ("train_loss_mean", "train_accuracy_mean",
+                              "val_loss_mean", "val_accuracy_mean")
+                    if k in epoch_summary
+                },
+            )
 
     # -- the loop (experiment_builder.py:302-371) -------------------------
 
@@ -539,9 +731,18 @@ class ExperimentBuilder:
                 self.trace_window.close(self._sync_device)
                 if self.watchdog is not None:
                     self.watchdog.stop()
-                # dynamics buffered since the last epoch flush (partial
-                # epoch at pause/crash), then the run_end marker
+                # dynamics/health buffered since the last epoch flush
+                # (partial epoch at pause/crash), then the run_end marker
                 self._flush_dynamics()
+                if self.health_monitor is not None:
+                    try:
+                        self.health_monitor.flush()
+                    except Exception as e:  # noqa: BLE001 - the pending
+                        # payload may be poisoned by the very device failure
+                        # that is unwinding this finally; evaluating it must
+                        # not mask that exception or lose run_end below
+                        print(f"[health] final flush failed: {e!r}",
+                              file=sys.stderr, flush=True)
                 self.telemetry.close()
 
     def _close_pbar(self):
@@ -645,6 +846,11 @@ class ExperimentBuilder:
                         path=ckpt_path,
                         also_latest=True,
                     )
+                    if self.flight_recorder is not None:
+                        self.flight_recorder.note_event(
+                            "checkpoint", epoch=int(self.epoch),
+                            path=ckpt_path,
+                        )
                     self._prune_saved_models()
                     self.total_losses = {}
                     self._pbar_sums = {}

@@ -585,6 +585,43 @@ class MAMLFewShotClassifier:
         out_preds = np.asarray(preds) if return_preds else None
         return dict(metrics), out_preds
 
+    def dump_state(
+        self, dump_dir: str, experiment_state: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Synchronous postmortem state dump for the flight recorder: write
+        the live ``MetaState`` (params + LSLR + BN + Adam moments) as an
+        orbax checkpoint under ``<dump_dir>/state`` plus the experiment
+        state as JSON — the same on-disk layout a regular checkpoint
+        directory has, so ``checkpoint.load_checkpoint``-style tooling can
+        restore it for inspection or a pre-divergence resume.
+
+        Single-host only: the monitor triggers on every host, and a
+        collective orbax save initiated from an anomaly path could
+        deadlock a mesh that is itself the thing misbehaving.
+        """
+        import json
+        import os
+
+        import orbax.checkpoint as ocp
+
+        if self.multihost:
+            raise RuntimeError(
+                "incident state dumps are single-host only; multihost runs "
+                "dump the flight-recorder ring without the state checkpoint"
+            )
+        ckpt.wait_for_pending()  # never interleave with an async epoch save
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(
+            os.path.join(os.path.abspath(dump_dir), "state"),
+            self.state._asdict(),
+        )
+        ckptr.wait_until_finished()
+        if experiment_state is not None:
+            with open(
+                os.path.join(dump_dir, "experiment_state.json"), "w"
+            ) as f:
+                json.dump(experiment_state, f, cls=ckpt._NumpyEncoder)
+
     def device_memory_stats(self) -> Dict[str, Any]:
         """Per-epoch device-memory telemetry: live HBM stats (when the
         backend exposes them — TPU does, CPU reports nothing) next to the

@@ -1,13 +1,28 @@
 """Structured telemetry: on-device training-dynamics metrics, JSONL /
-TensorBoard sinks, and the multihost hang watchdog.
+TensorBoard sinks, the multihost hang watchdog, and the training-health
+monitor.
 
 See ``schema.py`` for the event-record schema, ``sinks.py`` for the
-``Telemetry`` facade the experiment layer drives, and ``watchdog.py`` for
-the heartbeat hang watchdog.
+``Telemetry`` facade the experiment layer drives, ``watchdog.py`` for
+the heartbeat hang watchdog, ``health.py`` for the anomaly detector over
+the on-device probes, and ``flight_recorder.py`` for the incident ring /
+state-dump machinery.
 """
 
+from .flight_recorder import (  # noqa: F401
+    INCIDENT_MANIFEST,
+    RING_FILENAME,
+    FlightRecorder,
+)
+from .health import (  # noqa: F401
+    PROBE_KEYS,
+    AnomalyDetector,
+    HealthMonitor,
+    TrainingDivergedError,
+)
 from .schema import (  # noqa: F401
     KIND_FIELDS,
+    MIN_SCHEMA_VERSION,
     SCHEMA_VERSION,
     iter_records,
     validate_file,
@@ -18,5 +33,6 @@ from .sinks import (  # noqa: F401
     JsonlSink,
     Telemetry,
     TensorBoardSink,
+    make_record,
 )
 from .watchdog import Watchdog, thread_stacks  # noqa: F401

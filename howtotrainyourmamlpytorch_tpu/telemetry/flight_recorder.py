@@ -1,0 +1,168 @@
+"""Flight recorder: a bounded ring of recent training-health entries,
+dumped to disk when something goes wrong.
+
+The recorder is a host-side ``deque`` holding the last
+``flight_recorder_steps`` per-step probe entries plus the builder's
+lifecycle notes (epoch summaries, checkpoints, anomalies) — a few floats
+per step, so a 256-entry ring costs kilobytes. When an anomaly fires (or
+the hang watchdog stalls), ``dump()`` writes one incident directory under
+``logs/incidents/``:
+
+* ``incident.json`` — the trigger (reason, iteration, rule details),
+  timestamps, and what the dump contains;
+* ``ring.jsonl``    — the ring's entries, oldest first (the N steps of
+  context BEFORE the blow-up — exactly what a NaN postmortem needs and
+  what the epoch-granular CSV can never show);
+* ``state/``        — optionally, a full orbax checkpoint of the live
+  ``MetaState`` (params + LSLR + BN + Adam moments) via the caller's
+  ``state_dump_fn``, so the divergent state itself is inspectable/
+  resumable instead of being lost to the next (possibly NaN-poisoned)
+  checkpoint.
+
+Rate limiting: ``cooldown_steps`` suppresses a second dump within the
+window (a run wedged at NaN produces one incident per window, not one per
+step), and ``max_state_dumps`` caps the expensive state checkpoints per
+run — later incidents still write their ring + manifest.
+
+All entry points are lock-guarded: the hang watchdog dumps from its own
+thread while the train loop records.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .sinks import _jsonable
+
+INCIDENT_MANIFEST = "incident.json"
+RING_FILENAME = "ring.jsonl"
+
+
+class FlightRecorder:
+    """Ring buffer + anomaly-triggered incident dumps (see module doc)."""
+
+    def __init__(
+        self,
+        capacity: int,
+        incidents_dir: str,
+        max_state_dumps: int = 3,
+        cooldown_steps: int = 200,
+        is_primary: bool = True,
+    ):
+        self.capacity = int(capacity)
+        self.incidents_dir = incidents_dir
+        self.max_state_dumps = int(max_state_dumps)
+        self.cooldown_steps = int(cooldown_steps)
+        self.is_primary = bool(is_primary)
+        self._ring: deque = deque(maxlen=max(1, self.capacity))
+        self._lock = threading.Lock()
+        self._last_dump_iter: Optional[int] = None
+        self.state_dumps_done = 0
+        self.incidents_written = 0
+
+    @property
+    def enabled(self) -> bool:
+        """Non-primary hosts keep a no-op recorder (one incident per run,
+        not one per host, and the primary's ring sees the same replicated
+        metrics every host computes)."""
+        return self.capacity > 0 and self.is_primary
+
+    # -- ring producers (train loop + builder hooks) -----------------------
+
+    def record_step(self, entry: Dict[str, Any]) -> None:
+        """Append one per-step health entry (already host scalars)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(dict(entry))
+
+    def note_event(self, kind: str, **payload: Any) -> None:
+        """Append a lifecycle note (epoch summary, checkpoint, anomaly) so
+        the dumped ring shows WHERE in the run the steps sat."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append({"event": kind, "ts": time.time(), **payload})
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """The ring's current contents, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    # -- incident dumps ----------------------------------------------------
+
+    def dump(
+        self,
+        reason: str,
+        iter_idx: int,
+        details: Optional[Dict[str, Any]] = None,
+        state_dump_fn: Optional[Callable[[str], None]] = None,
+        force: bool = False,
+    ) -> Optional[str]:
+        """Write one incident directory; returns its path, or None when the
+        recorder is disabled or the cooldown suppressed the dump.
+
+        ``state_dump_fn(path)`` — when given and under the
+        ``max_state_dumps`` cap — is called with the incident directory to
+        add the ``state/`` checkpoint; its failure is recorded in the
+        manifest, never raised (an incident dump must not kill the run it
+        is documenting). ``force=True`` bypasses the cooldown (never the
+        disabled/non-primary gate): the halt escalation's final forensic
+        dump must not be swallowed because a routine anomaly dumped
+        moments earlier.
+        """
+        iter_idx = int(iter_idx)
+        with self._lock:
+            if not self.enabled:
+                return None
+            if (
+                not force
+                and self._last_dump_iter is not None
+                and self.cooldown_steps > 0
+                and 0 <= iter_idx - self._last_dump_iter < self.cooldown_steps
+            ):
+                return None
+            self._last_dump_iter = iter_idx
+            ring = list(self._ring)
+            self.incidents_written += 1
+            dump_state = (
+                state_dump_fn is not None
+                and self.state_dumps_done < self.max_state_dumps
+            )
+            if dump_state:
+                self.state_dumps_done += 1
+
+        base = os.path.join(
+            self.incidents_dir, f"incident_iter{iter_idx:08d}_{reason}"
+        )
+        path, n = base, 1
+        while os.path.exists(path):  # same iter+reason twice: never clobber
+            path = f"{base}.{n}"
+            n += 1
+        os.makedirs(path)
+        with open(os.path.join(path, RING_FILENAME), "w") as f:
+            for entry in ring:
+                f.write(json.dumps(_jsonable(entry)) + "\n")
+        state_error = None
+        if dump_state:
+            try:
+                state_dump_fn(path)
+            except Exception as e:  # noqa: BLE001 - see docstring
+                state_error = repr(e)
+        manifest = {
+            "reason": reason,
+            "iter": iter_idx,
+            "ts": time.time(),
+            "ring_entries": len(ring),
+            "state_dumped": bool(dump_state and state_error is None),
+            "state_error": state_error,
+            "details": _jsonable(details or {}),
+        }
+        with open(os.path.join(path, INCIDENT_MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+        return path

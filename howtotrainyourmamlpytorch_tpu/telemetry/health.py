@@ -1,0 +1,363 @@
+"""Host-side training-health monitor over the on-device anomaly probes.
+
+``health_level != 'off'`` makes every train dispatch return a tiny
+``metrics['health']`` dict computed on device (core/maml._health_probes):
+the outer loss, the PRE-clip global meta-gradient L2 norm, the count of
+non-finite gradient elements, and the update/parameter norms. The
+``HealthMonitor`` here consumes those payloads with a ONE-DISPATCH LAG:
+the system facade's one-step-lag sync guarantees that by the time dispatch
+N+1 is enqueued, dispatch N's outputs are materialised on device — so
+fetching them then is a copy of ready buffers, never a blocking sync, and
+the hot loop keeps its zero-added-syncs contract. The price is that an
+anomaly is detected up to one dispatch (``steps_per_dispatch`` iterations)
+after it happened; the flight recorder's ring preserves the lead-up
+regardless.
+
+Detection rules (``AnomalyDetector``):
+
+* ``nonfinite_grads`` / ``nonfinite_loss`` — always armed: any non-finite
+  gradient element or loss is an anomaly (MAML++'s second-order path
+  through an unrolled inner loop is exactly where an inf/NaN appears many
+  iterations before the epoch CSV shows it);
+* ``loss_spike`` / ``grad_norm_spike`` — EMA-relative: value > factor ×
+  its own exponential moving average, armed after ``warmup_steps``
+  observations (factor 0 disables the rule);
+* ``grad_norm_limit`` — absolute ceiling on the pre-clip global grad norm
+  (0 disables): no warmup needed, so it also catches a run whose
+  gradients are already huge at step 0;
+* ``update_ratio`` — absolute ceiling on ||update|| / ||params|| (0
+  disables): a single outer step moving the parameters by a large fraction
+  of their norm means the LR/LSLR schedule has blown up.
+
+Each fired rule is suppressed for ``cooldown_steps`` iterations (a run
+wedged at NaN reports once per window, not once per step). Anomalies are
+emitted as schema-versioned ``anomaly`` telemetry records, logged to
+stderr, and handed to the :class:`~.flight_recorder.FlightRecorder`,
+which dumps its ring + (when legal) a full state checkpoint as an
+``incident``.
+
+Escalation (``health_level='halt'``): the detector counts anomalous
+iterations regardless of cooldown suppression; once the count reaches
+``health_patience``, the monitor latches a halt decision. The experiment
+builder — the owner of checkpointing — observes ``should_halt`` on the
+train-loop thread, writes a resumable emergency checkpoint
+(``train_model_emergency``) plus a final forced incident dump, and raises
+:class:`TrainingDivergedError` instead of training on garbage.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+#: the keys core/maml._health_probes returns per step
+PROBE_KEYS = (
+    "loss", "grad_norm", "nonfinite_grads", "update_norm", "param_norm",
+)
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised by a ``health_level='halt'`` run once ``health_patience``
+    anomalous iterations have been observed — after the emergency
+    checkpoint and the forensic incident dump are on disk (their locations
+    ride on the exception for the caller / crash log)."""
+
+    def __init__(
+        self,
+        message: str,
+        iter_at_halt: Optional[int] = None,
+        dump_dir: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.iter_at_halt = iter_at_halt
+        self.dump_dir = dump_dir
+        self.checkpoint_path = checkpoint_path
+
+
+class AnomalyDetector:
+    """Pure host-side rule engine over per-step probe entries (see module
+    doc for the rules). ``update()`` returns the anomalies one step fired —
+    each a dict with ``iter``, ``reason``, ``value``, ``threshold``."""
+
+    def __init__(
+        self,
+        loss_spike_factor: float = 10.0,
+        grad_spike_factor: float = 10.0,
+        update_ratio_max: float = 0.0,
+        grad_norm_limit: float = 0.0,
+        ema_beta: float = 0.98,
+        warmup_steps: int = 20,
+        cooldown_steps: int = 200,
+    ):
+        self.loss_spike_factor = float(loss_spike_factor)
+        self.grad_spike_factor = float(grad_spike_factor)
+        self.update_ratio_max = float(update_ratio_max)
+        self.grad_norm_limit = float(grad_norm_limit)
+        self.ema_beta = float(ema_beta)
+        self.warmup_steps = int(warmup_steps)
+        self.cooldown_steps = int(cooldown_steps)
+        self._ema: Dict[str, float] = {}
+        self._seen = 0
+        self._last_fired: Dict[str, int] = {}
+        #: iterations where any rule condition HELD — counted even when the
+        #: cooldown suppressed the report, so halt patience cannot be
+        #: stretched by the per-reason report rate limiting
+        self.anomalous_iterations = 0
+        self._iter_flagged = False
+
+    @classmethod
+    def from_config(cls, cfg) -> "AnomalyDetector":
+        return cls(
+            loss_spike_factor=cfg.anomaly_loss_spike_factor,
+            grad_spike_factor=cfg.anomaly_grad_spike_factor,
+            update_ratio_max=cfg.anomaly_update_ratio_max,
+            grad_norm_limit=cfg.health_grad_norm_limit,
+            ema_beta=cfg.anomaly_ema_beta,
+            warmup_steps=cfg.anomaly_warmup_steps,
+            cooldown_steps=cfg.anomaly_cooldown_steps,
+        )
+
+    def ema(self, key: str) -> Optional[float]:
+        return self._ema.get(key)
+
+    def _fire(
+        self, out: List[Dict[str, Any]], iter_idx: int, reason: str,
+        value: float, threshold: float,
+    ) -> None:
+        self._iter_flagged = True  # condition held; cooldown only gates
+        last = self._last_fired.get(reason)  # the report below
+        if (
+            last is not None
+            and self.cooldown_steps > 0
+            and 0 <= iter_idx - last < self.cooldown_steps
+        ):
+            return
+        self._last_fired[reason] = iter_idx
+        out.append({
+            "iter": int(iter_idx),
+            "reason": reason,
+            "value": float(value),
+            "threshold": float(threshold),
+        })
+
+    def _spike(
+        self, out, iter_idx, reason: str, key: str, value: float,
+        factor: float,
+    ) -> None:
+        """EMA-relative spike rule for ``key``; also folds ``value`` into
+        the EMA (finite values only — a NaN loss must not poison the
+        baseline the recovery will be judged against)."""
+        baseline = self._ema.get(key)
+        armed = (
+            factor > 0
+            and baseline is not None
+            and self._seen >= self.warmup_steps
+        )
+        if armed and math.isfinite(value) and value > factor * baseline:
+            self._fire(out, iter_idx, reason, value, factor * baseline)
+        if math.isfinite(value):
+            if baseline is None:
+                self._ema[key] = value
+            else:
+                b = self.ema_beta
+                self._ema[key] = b * baseline + (1.0 - b) * value
+
+    def update(
+        self, iter_idx: int, entry: Dict[str, Any]
+    ) -> List[Dict[str, Any]]:
+        anomalies: List[Dict[str, Any]] = []
+        self._iter_flagged = False
+        loss = float(entry.get("loss", np.nan))
+        grad_norm = float(entry.get("grad_norm", np.nan))
+        nonfinite = int(entry.get("nonfinite_grads", 0))
+        if nonfinite > 0:
+            self._fire(anomalies, iter_idx, "nonfinite_grads",
+                       nonfinite, 0.0)
+        if not math.isfinite(loss):
+            self._fire(anomalies, iter_idx, "nonfinite_loss", loss, 0.0)
+        if "grad_norm" in entry and not math.isfinite(grad_norm) \
+                and nonfinite == 0:
+            # every gradient ELEMENT is finite but the f32 sum-of-squares
+            # reduction overflowed to inf: the update built from this
+            # gradient is garbage, yet no element-level rule sees it —
+            # always armed, like the other non-finite rules
+            self._fire(anomalies, iter_idx, "nonfinite_grad_norm",
+                       grad_norm, 0.0)
+        self._spike(anomalies, iter_idx, "loss_spike", "loss", loss,
+                    self.loss_spike_factor)
+        self._spike(anomalies, iter_idx, "grad_norm_spike", "grad_norm",
+                    grad_norm, self.grad_spike_factor)
+        if (
+            self.grad_norm_limit > 0
+            and math.isfinite(grad_norm)
+            and grad_norm > self.grad_norm_limit
+        ):
+            # a non-finite norm is the nonfinite_grads /
+            # nonfinite_grad_norm rules' job (both always armed)
+            self._fire(anomalies, iter_idx, "grad_norm_limit", grad_norm,
+                       self.grad_norm_limit)
+        if self.update_ratio_max > 0:
+            ratio = float(entry.get("update_norm", 0.0)) / (
+                float(entry.get("param_norm", 0.0)) + 1e-12
+            )
+            if math.isfinite(ratio) and ratio > self.update_ratio_max:
+                self._fire(anomalies, iter_idx, "update_ratio", ratio,
+                           self.update_ratio_max)
+        self._seen += 1
+        if self._iter_flagged:
+            self.anomalous_iterations += 1
+        return anomalies
+
+
+class HealthMonitor:
+    """Builder-side driver: defers each dispatch's device probe payload,
+    evaluates the previous one (already materialised by the one-step-lag
+    sync), feeds the ring, and reports anomalies (telemetry ``anomaly``
+    record + stderr line + flight-recorder ``incident`` dump)."""
+
+    def __init__(
+        self,
+        cfg,
+        telemetry=None,
+        recorder=None,
+        state_dump_fn: Optional[Callable[[str], None]] = None,
+    ):
+        self.detector = AnomalyDetector.from_config(cfg)
+        self.level = cfg.health_level
+        self.patience = int(cfg.health_patience)
+        self.telemetry = telemetry
+        self.recorder = recorder
+        self.state_dump_fn = state_dump_fn
+        self._pending = None  # (iter_start, device payload)
+        self.anomaly_count = 0
+        self.steps_seen = 0
+        #: the most recently evaluated per-step entry (watchdog-stall
+        #: context: "what did training health look like when we hung")
+        self.last_entry: Optional[Dict[str, Any]] = None
+        #: latched halt decision (health_level='halt' only): the anomaly
+        #: that crossed the patience threshold. The builder reads
+        #: ``should_halt`` on the train-loop thread and performs the
+        #: emergency checkpoint + dump + raise — the monitor never raises
+        #: itself, so detection stays side-effect-free and testable.
+        self.halt_anomaly: Optional[Dict[str, Any]] = None
+
+    @property
+    def should_halt(self) -> bool:
+        return self.halt_anomaly is not None
+
+    # -- intake ------------------------------------------------------------
+
+    def observe(self, iter_start: int, health) -> None:
+        """Queue this dispatch's (device-array) probe payload; evaluate the
+        PREVIOUS dispatch's, whose buffers the one-step-lag sync has
+        already made ready — detection without ever blocking on the
+        dispatch just enqueued."""
+        prev, self._pending = self._pending, (int(iter_start), health)
+        if prev is not None:
+            self._evaluate(*prev)
+
+    def flush(self) -> None:
+        """Evaluate the still-deferred last dispatch (epoch summary / run
+        end — the one place the monitor does pay a device sync, where the
+        builder is already synchronizing for the summary anyway)."""
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._evaluate(*prev)
+
+    # -- evaluation --------------------------------------------------------
+
+    @staticmethod
+    def _entries(payload) -> List[Dict[str, Any]]:
+        """One host dict per iteration from a dispatch payload: a dict of
+        scalars (plain step), of (k,)-stacked arrays (fused multi-step), or
+        a list of per-iteration dicts (the multihost fallback path)."""
+        import jax
+
+        payload = jax.device_get(payload)
+        if isinstance(payload, list):
+            dicts = payload
+        else:
+            arrs = {
+                k: np.atleast_1d(np.asarray(v)) for k, v in payload.items()
+            }
+            n = len(next(iter(arrs.values()))) if arrs else 0
+            dicts = [
+                {k: a[i] for k, a in arrs.items()} for i in range(n)
+            ]
+        return [
+            {k: np.asarray(v).item() for k, v in d.items()} for d in dicts
+        ]
+
+    def _evaluate(self, iter_start: int, payload) -> None:
+        for j, probes in enumerate(self._entries(payload)):
+            it = iter_start + j
+            entry = {"iter": it, **probes}
+            self.steps_seen += 1
+            self.last_entry = entry
+            if self.recorder is not None:
+                self.recorder.record_step(entry)
+            anomalies = self.detector.update(it, entry)
+            for anomaly in anomalies:
+                self._report(anomaly, entry)
+            if (
+                self.level == "halt"
+                and self.halt_anomaly is None
+                and self.detector.anomalous_iterations >= self.patience
+            ):
+                # latch on the anomalous-ITERATION count, not the reported
+                # anomalies: cooldown suppression must not stretch patience
+                self.halt_anomaly = (
+                    anomalies[0] if anomalies
+                    else {"iter": it, "reason": "anomaly_under_cooldown",
+                          "value": float("nan"), "threshold": float("nan")}
+                )
+
+    def _report(self, anomaly: Dict[str, Any], entry: Dict[str, Any]) -> None:
+        self.anomaly_count += 1
+        print(
+            f"[health] anomaly at iter {anomaly['iter']}: "
+            f"{anomaly['reason']} (value={anomaly['value']:.6g}, "
+            f"threshold={anomaly['threshold']:.6g})",
+            file=sys.stderr,
+            flush=True,
+        )
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "anomaly",
+                iter=anomaly["iter"],
+                reason=anomaly["reason"],
+                value=anomaly["value"],
+                threshold=anomaly["threshold"],
+                probes=entry,
+            )
+        if self.recorder is None:
+            return
+        self.recorder.note_event("anomaly", **anomaly)
+        try:
+            path = self.recorder.dump(
+                anomaly["reason"],
+                anomaly["iter"],
+                details={"anomaly": anomaly, "probes": entry},
+                state_dump_fn=self.state_dump_fn,
+            )
+        except Exception as e:  # noqa: BLE001 - best-effort forensics: a
+            # disk-full/permission error writing the incident must not kill
+            # the (possibly healthy-again) run it is documenting
+            print(f"[health] incident dump failed: {e!r}", file=sys.stderr,
+                  flush=True)
+            return
+        if path is None:
+            return
+        print(f"[health] incident dumped to {path}", file=sys.stderr,
+              flush=True)
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "incident",
+                iter=anomaly["iter"],
+                reason=anomaly["reason"],
+                path=path,
+            )

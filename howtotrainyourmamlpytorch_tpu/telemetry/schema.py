@@ -21,7 +21,34 @@ Record kinds:
   MSL weight vector);
 * ``trace``          — profiler trace-window start/stop;
 * ``watchdog_stall`` — the hang watchdog's diagnostic record (current
-  stage, seconds since progress, all-thread stack snapshot).
+  stage, seconds since progress, all-thread stack snapshot; since v2 also
+  the flight-recorder tail and the last evaluated health entry when the
+  training-health monitor is on — hang and divergence diagnosable from
+  one record);
+* ``anomaly``        — a training-health rule fired (non-finite grads/loss,
+  EMA-relative loss/grad-norm spike, absolute grad-norm/update-ratio
+  ceiling): the iteration, the rule, the offending value vs its threshold,
+  and the full probe entry;
+* ``incident``       — the flight recorder dumped its ring (and, when
+  legal, a full state checkpoint) to ``logs/incidents/<name>/`` — the
+  record carries the reason and the on-disk path. Reason ``halt`` marks
+  the escalation dump written just before ``TrainingDivergedError``.
+
+Version history / migration notes:
+
+* **v1** — initial schema (run lifecycle, epoch/stream/dispatch/checkpoint/
+  device_memory/dynamics/trace/watchdog_stall).
+* **v2** — adds the ``anomaly`` and ``incident`` record kinds (the
+  training-health monitor) and the optional ``nonfinite_count`` /
+  ``nonfinite_fields`` envelope fields (how many non-finite values the
+  sink masked to null in this record, total and per payload field — the
+  anomaly signal stays queryable from JSONL). Pure additions: every v1
+  record validates unchanged under the v2 validator, and v2 validators
+  accept records stamped with any version in
+  ``[MIN_SCHEMA_VERSION, SCHEMA_VERSION]``. Records stamped with a NEWER
+  version are tolerated envelope-only (numeric ``ts``, non-empty string
+  ``kind``): unknown kinds and unknown fields from future schemas must
+  never make an old reader reject a log it can still mostly use.
 """
 
 from __future__ import annotations
@@ -29,7 +56,9 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+#: oldest version this validator fully understands (v1 is a strict subset)
+MIN_SCHEMA_VERSION = 1
 
 #: kind -> required payload fields (beyond the schema/ts/kind envelope)
 KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
@@ -45,21 +74,42 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
                  "target_losses", "grad_norms", "lslr", "msl_weights"),
     "trace": ("action",),
     "watchdog_stall": ("stage", "seconds_since_progress", "stacks"),
+    "anomaly": ("iter", "reason", "value", "threshold"),
+    "incident": ("iter", "reason", "path"),
 }
 
 
 def validate_record(rec: Any) -> None:
-    """Raise ``ValueError`` when ``rec`` is not a valid telemetry record."""
+    """Raise ``ValueError`` when ``rec`` is not a valid telemetry record.
+
+    Forward-compatible by design: a record stamped with a schema version
+    NEWER than this validator gets envelope-only checks (numeric ``ts``,
+    non-empty string ``kind``) — unknown kinds and unknown fields from a
+    future writer pass, so mixed-version logs (resumed runs across
+    upgrades, ``telemetry_cli diff`` against a newer run) stay readable.
+    Non-integer or pre-``MIN_SCHEMA_VERSION`` versions are still rejected:
+    they indicate corruption, not the future.
+    """
     if not isinstance(rec, dict):
         raise ValueError(f"telemetry record must be an object, got {type(rec).__name__}")
-    if rec.get("schema") != SCHEMA_VERSION:
+    ver = rec.get("schema")
+    if isinstance(ver, bool) or not isinstance(ver, int) or ver < MIN_SCHEMA_VERSION:
         raise ValueError(
-            f"unknown telemetry schema version {rec.get('schema')!r} "
-            f"(this validator understands {SCHEMA_VERSION})"
+            f"unknown telemetry schema version {ver!r} (this validator "
+            f"understands {MIN_SCHEMA_VERSION}..{SCHEMA_VERSION} and "
+            "tolerates newer)"
         )
     if not isinstance(rec.get("ts"), (int, float)):
         raise ValueError(f"telemetry record missing numeric 'ts': {rec!r}")
     kind = rec.get("kind")
+    if ver > SCHEMA_VERSION:
+        # a newer writer: envelope checked above; its kinds and fields are
+        # its own business
+        if not isinstance(kind, str) or not kind:
+            raise ValueError(
+                f"telemetry record missing string 'kind': {rec!r}"
+            )
+        return
     if kind not in KIND_FIELDS:
         raise ValueError(
             f"unknown telemetry record kind {kind!r}; known kinds: "

@@ -26,37 +26,78 @@ from .schema import SCHEMA_VERSION
 TELEMETRY_FILENAME = "telemetry.jsonl"
 
 
-def _jsonable(value: Any) -> Any:
+def _jsonable(value: Any, counter: Optional[list] = None) -> Any:
     """Recursively convert numpy/device arrays and scalars to JSON types.
 
     Non-finite floats become null: json.dumps would otherwise emit bare
     NaN/Infinity tokens, which Python's json accepts but spec-strict
     consumers (jq, JSON.parse, warehouse loaders) reject — and a diverging
-    run is exactly when the log must stay machine-readable. The one
+    run is exactly when the log must stay machine-readable. The masking is
+    *counted*, not silent: ``counter`` (a single-element mutable list, when
+    given) accumulates how many non-finite values were nulled, and
+    ``make_record`` attaches the totals to the record envelope — so the
+    anomaly signal the nulls erase stays queryable from JSONL. The one
     device->host synchronization for dynamics happens here, at flush time —
     never inside the train loop.
     """
     if isinstance(value, dict):
-        return {str(k): _jsonable(v) for k, v in value.items()}
+        return {str(k): _jsonable(v, counter) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
-        return [_jsonable(v) for v in value]
+        return [_jsonable(v, counter) for v in value]
     if isinstance(value, float):
-        return value if math.isfinite(value) else None
+        if math.isfinite(value):
+            return value
+        if counter is not None:
+            counter[0] += 1
+        return None
     if isinstance(value, (str, bool, int)) or value is None:
         return value
     arr = np.asarray(value)
     if arr.ndim == 0:
-        return _jsonable(arr.item())
+        return _jsonable(arr.item(), counter)
     if not (np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_):
         # float64 normalizes the extended float dtypes too (bfloat16 is
         # dtype kind 'V', which issubdtype(..., floating) misses) so the
         # finiteness mask can never be skipped for a float-like payload
         arr = arr.astype(np.float64)
-        if not np.isfinite(arr).all():
+        finite = np.isfinite(arr)
+        if not finite.all():
+            if counter is not None:
+                counter[0] += int((~finite).sum())
             out = arr.astype(object)
-            out[~np.isfinite(arr)] = None
+            out[~finite] = None
             return out.tolist()
     return arr.tolist()
+
+
+def make_record(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Build one schema-enveloped, JSON-safe record from raw field values.
+
+    The single construction point for every telemetry record. Converts
+    every field through ``_jsonable`` tracking per-field non-finite counts;
+    when any value was masked to null the envelope gains
+    ``nonfinite_count`` (total) and ``nonfinite_fields`` (per payload
+    field) — for array payloads like the dynamics stacks this is the
+    per-array count that makes "which stack went NaN, and how badly"
+    answerable without the original device arrays.
+    """
+    payload: Dict[str, Any] = {}
+    counts: Dict[str, int] = {}
+    for key, value in fields.items():
+        counter = [0]
+        payload[str(key)] = _jsonable(value, counter)
+        if counter[0]:
+            counts[str(key)] = counter[0]
+    record: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "ts": time.time(),
+        "kind": kind,
+        **payload,
+    }
+    if counts:
+        record["nonfinite_count"] = sum(counts.values())
+        record["nonfinite_fields"] = counts
+    return record
 
 
 class JsonlSink:
@@ -169,13 +210,7 @@ class Telemetry:
         """Write one schema-versioned record (thread-safe)."""
         if not self.enabled or self.jsonl is None:
             return
-        record = {
-            "schema": SCHEMA_VERSION,
-            "ts": time.time(),
-            "kind": kind,
-            **_jsonable(fields),
-        }
-        self.jsonl.write(record)
+        self.jsonl.write(make_record(kind, **fields))
 
     def epoch_scalars(self, epoch: int, scalars: Dict[str, Any]) -> None:
         """The per-epoch summary: one JSONL record + TensorBoard mirror."""

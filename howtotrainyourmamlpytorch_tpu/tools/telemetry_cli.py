@@ -1,0 +1,532 @@
+"""Inspect / diff telemetry JSONL logs from the command line.
+
+The structured event log (``logs/telemetry.jsonl``, one schema-versioned
+JSON record per line — see ``telemetry/schema.py``) is the run's flight
+data; this CLI is the reader, so a diverging TPU run can be diagnosed
+from any shell with the repo checked out and nothing else:
+
+.. code-block:: console
+
+   python -m howtotrainyourmamlpytorch_tpu.tools.telemetry_cli summary LOG
+   python -m howtotrainyourmamlpytorch_tpu.tools.telemetry_cli epochs LOG
+   python -m howtotrainyourmamlpytorch_tpu.tools.telemetry_cli anomalies LOG
+   python -m howtotrainyourmamlpytorch_tpu.tools.telemetry_cli tail LOG -n 20 --kind epoch
+   python -m howtotrainyourmamlpytorch_tpu.tools.telemetry_cli diff LOG_A LOG_B
+   python -m howtotrainyourmamlpytorch_tpu.tools.telemetry_cli validate LOG
+
+(also reachable as ``python -m howtotrainyourmamlpytorch_tpu.cli
+inspect <subcommand> ...`` — the training CLI dispatches ``inspect``
+here before importing anything jax-heavy)
+
+* ``summary``   — run overview: record counts by kind, wall-clock span,
+  epoch range, final/best validation accuracy, dispatch-timing
+  percentiles, loader stream-stall stats, HBM usage, and
+  anomaly/incident/stall counts;
+* ``epochs``    — the per-epoch scalar table (loss/accuracy/step-time
+  columns), the epoch CSV's queryable twin;
+* ``anomalies`` — every ``anomaly`` / ``incident`` / ``watchdog_stall``
+  record, one line each (the postmortem index / anomaly timeline);
+* ``tail``      — the last N records, optionally filtered by kind;
+* ``diff``      — align two runs' per-epoch scalars, report per-metric
+  deltas and the first epoch where a watched metric diverges beyond
+  tolerance, plus the config-key diff from the ``run_start`` snapshots
+  ("what changed between these two runs, and when did it start
+  mattering");
+* ``validate``  — schema-validate every record (exit 1 on the first
+  offender; what the CI telemetry-smoke job runs).
+
+Every subcommand takes ``--json`` for machine-readable output. Pure
+stdlib + ``telemetry.schema`` — importable without jax, so it runs on a
+laptop against a log scp'd off a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..telemetry.schema import iter_records, validate_file
+
+#: metrics `diff` watches for the divergence epoch unless --metric is given
+DEFAULT_WATCH_METRICS = ("train_loss_mean", "val_accuracy_mean")
+
+ANOMALY_KINDS = ("anomaly", "incident", "watchdog_stall")
+
+
+def _load(path: str) -> List[dict]:
+    return list(iter_records(path))
+
+
+def _fmt_ts_span(records: List[dict]) -> Optional[float]:
+    ts = [r["ts"] for r in records if isinstance(r.get("ts"), (int, float))]
+    return (max(ts) - min(ts)) if ts else None
+
+
+def _epoch_scalars(records: Iterable[dict]) -> Dict[int, Dict[str, float]]:
+    """epoch -> scalars from the ``epoch`` records (last write wins, so a
+    resumed run's re-trained epoch reads as its final numbers)."""
+    out: Dict[int, Dict[str, float]] = {}
+    for r in records:
+        if (
+            r.get("kind") == "epoch"
+            and isinstance(r.get("scalars"), dict)
+            and isinstance(r.get("epoch"), (int, float))
+        ):
+            out[int(r["epoch"])] = {
+                k: v for k, v in r["scalars"].items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+    return out
+
+
+def _emit(payload: Dict[str, Any], as_json: bool, text_lines: List[str]) -> None:
+    if as_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print("\n".join(text_lines))
+
+
+def _mean_of(records: List[dict], kind: str, keys: Tuple[str, ...]) -> Dict[str, float]:
+    """Per-key mean over every record of ``kind`` that carries the key
+    (numeric, finite)."""
+    out: Dict[str, float] = {}
+    for key in keys:
+        vals = [
+            r[key] for r in records
+            if r.get("kind") == kind
+            and isinstance(r.get(key), (int, float))
+            and not isinstance(r.get(key), bool)
+            and math.isfinite(r[key])
+        ]
+        if vals:
+            out[key] = sum(vals) / len(vals)
+    return out
+
+
+def _dispatch_stats(records: List[dict]) -> Optional[Dict[str, float]]:
+    """Step-time stats averaged over the run's ``dispatch`` records (the
+    per-epoch StepTimer summaries: mean/p50/p95/p99 dispatch latency)."""
+    return _mean_of(records, "dispatch", (
+        "train_step_time_ms", "train_step_time_p50_ms",
+        "train_step_time_p95_ms", "train_step_time_p99_ms",
+        "train_iters_per_sec",
+    )) or None
+
+
+def _stream_stats(records: List[dict]) -> Optional[Dict[str, float]]:
+    return _mean_of(records, "stream", (
+        "assembly_ms_per_batch", "stall_ms_per_batch", "queue_depth_mean",
+    )) or None
+
+
+def _memory_stats(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """The LAST ``device_memory`` record's HBM numbers (current state
+    matters more than history for leak triage)."""
+    mem = [r for r in records if r.get("kind") == "device_memory"]
+    if not mem:
+        return None
+    last = mem[-1]
+    return {
+        k: last[k]
+        for k in ("epoch", "bytes_in_use", "peak_bytes_in_use",
+                  "bytes_limit", "store_bytes_expected")
+        if k in last
+    }
+
+
+# -- summary ----------------------------------------------------------------
+
+
+def cmd_summary(args) -> int:
+    records = _load(args.log)
+    counts: Dict[str, int] = {}
+    for r in records:
+        counts[r.get("kind", "?")] = counts.get(r.get("kind", "?"), 0) + 1
+    epochs = _epoch_scalars(records)
+    run_start = next((r for r in records if r.get("kind") == "run_start"), None)
+    val_acc = {
+        e: s["val_accuracy_mean"]
+        for e, s in epochs.items() if "val_accuracy_mean" in s
+    }
+    best = max(val_acc.items(), key=lambda kv: kv[1]) if val_acc else None
+    final = max(val_acc) if val_acc else None
+    span = _fmt_ts_span(records)
+    payload: Dict[str, Any] = {
+        "log": args.log,
+        "records": len(records),
+        "counts_by_kind": counts,
+        "experiment_name": (run_start or {}).get("experiment_name"),
+        "telemetry_level": (run_start or {}).get("telemetry_level"),
+        "epochs": sorted(epochs) and [min(epochs), max(epochs)] or None,
+        "wall_clock_seconds": round(span, 3) if span is not None else None,
+        "final_val_accuracy": val_acc.get(final) if final is not None else None,
+        "best_val_accuracy": best[1] if best else None,
+        "best_val_epoch": best[0] if best else None,
+        "dispatch_timing": _dispatch_stats(records),
+        "stream": _stream_stats(records),
+        "device_memory": _memory_stats(records),
+        "anomalies": counts.get("anomaly", 0),
+        "incidents": counts.get("incident", 0),
+        "watchdog_stalls": counts.get("watchdog_stall", 0),
+        "clean_shutdown": counts.get("run_end", 0) > 0,
+    }
+    lines = [
+        f"{args.log}: {len(records)} records"
+        + (f" over {span:.1f}s" if span is not None else ""),
+        "  kinds: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(counts.items())
+        ),
+    ]
+    if run_start:
+        lines.append(
+            f"  run: {run_start.get('experiment_name')!r} "
+            f"(telemetry_level={run_start.get('telemetry_level')}, "
+            f"resume_iter={run_start.get('resume_iter')})"
+        )
+    if epochs:
+        lines.append(f"  epochs: {min(epochs)}..{max(epochs)}")
+    if best:
+        lines.append(
+            f"  val accuracy: best {best[1]:.4f} @ epoch {best[0]}, "
+            f"final {val_acc[final]:.4f} @ epoch {final}"
+        )
+    disp = payload["dispatch_timing"]
+    if disp:
+        parts = [f"mean {disp['train_step_time_ms']:.1f}ms"] if (
+            "train_step_time_ms" in disp
+        ) else []
+        for q in ("p50", "p95", "p99"):
+            key = f"train_step_time_{q}_ms"
+            if key in disp:
+                parts.append(f"{q} {disp[key]:.1f}ms")
+        lines.append("  dispatch: " + ", ".join(parts))
+    stream = payload["stream"]
+    if stream:
+        lines.append(
+            "  stream: "
+            + ", ".join(f"{k}={v:.2f}" for k, v in stream.items())
+        )
+    mem = payload["device_memory"]
+    if mem and "bytes_in_use" in mem:
+        lines.append(
+            f"  hbm: {mem['bytes_in_use'] / 2**20:.1f} MiB in use"
+            + (
+                f" (peak {mem['peak_bytes_in_use'] / 2**20:.1f} MiB)"
+                if "peak_bytes_in_use" in mem else ""
+            )
+            + f", stores expect {mem.get('store_bytes_expected', 0) / 2**20:.1f} MiB"
+        )
+    health = (
+        f"  health: {payload['anomalies']} anomalies, "
+        f"{payload['incidents']} incidents, "
+        f"{payload['watchdog_stalls']} watchdog stalls"
+    )
+    if not payload["clean_shutdown"]:
+        health += "  [no run_end marker: crashed, killed, or still running]"
+    lines.append(health)
+    _emit(payload, args.json, lines)
+    return 0
+
+
+# -- epochs -----------------------------------------------------------------
+
+#: columns the `epochs` table shows by default (when present in the log)
+DEFAULT_EPOCH_COLUMNS = (
+    "train_loss_mean", "train_accuracy_mean",
+    "val_loss_mean", "val_accuracy_mean", "train_step_time_ms",
+)
+
+
+def cmd_epochs(args) -> int:
+    epochs = _epoch_scalars(_load(args.log))
+    if not epochs:
+        _emit({"log": args.log, "epochs": {}}, args.json, ["no epoch records"])
+        return 0
+    cols = tuple(args.column) if args.column else tuple(
+        c for c in DEFAULT_EPOCH_COLUMNS
+        if any(c in s for s in epochs.values())
+    )
+    payload = {
+        "log": args.log,
+        "columns": list(cols),
+        "epochs": {
+            str(e): {c: epochs[e].get(c) for c in cols}
+            for e in sorted(epochs)
+        },
+    }
+    width = max(12, *(len(c) for c in cols)) if cols else 12
+    lines = ["epoch  " + "  ".join(c.rjust(width) for c in cols)]
+    for e in sorted(epochs):
+        cells = []
+        for c in cols:
+            v = epochs[e].get(c)
+            cells.append(
+                (f"{v:.4f}" if isinstance(v, float) else str(v)).rjust(width)
+            )
+        lines.append(f"{e:>5}  " + "  ".join(cells))
+    _emit(payload, args.json, lines)
+    return 0
+
+
+# -- anomalies --------------------------------------------------------------
+
+
+def cmd_anomalies(args) -> int:
+    records = [r for r in _load(args.log) if r.get("kind") in ANOMALY_KINDS]
+    lines = []
+    for r in records:
+        kind = r["kind"]
+        # a newer-schema record may omit fields we print (forward-compat:
+        # the reader renders what it recognizes, never crashes) — str() the
+        # iter rather than assume an int is present
+        it = str(r.get("iter", "?"))
+        if kind == "anomaly":
+            lines.append(
+                f"anomaly   iter {it:>8}  {r.get('reason')}"
+                f"  value={r.get('value')}  threshold={r.get('threshold')}"
+            )
+        elif kind == "incident":
+            lines.append(
+                f"incident  iter {it:>8}  {r.get('reason')}"
+                f"  -> {r.get('path')}"
+            )
+        else:
+            lines.append(
+                f"stall     stage={r.get('stage')!r}  "
+                f"{r.get('seconds_since_progress')}s without progress"
+            )
+    if not lines:
+        lines = ["no anomalies, incidents, or watchdog stalls recorded"]
+    _emit({"log": args.log, "events": records}, args.json, lines)
+    return 0
+
+
+# -- tail -------------------------------------------------------------------
+
+
+def cmd_tail(args) -> int:
+    if args.n <= 0:
+        print(f"tail: -n must be positive, got {args.n}", file=sys.stderr)
+        return 2
+    records = _load(args.log)
+    if args.kind:
+        records = [r for r in records if r.get("kind") == args.kind]
+    records = records[-args.n:]
+    lines = [json.dumps(r, sort_keys=True) for r in records]
+    if not lines:
+        lines = [
+            "no records"
+            + (f" of kind {args.kind!r}" if args.kind else "")
+        ]
+    _emit({"log": args.log, "records": records}, args.json, lines)
+    return 0
+
+
+# -- diff -------------------------------------------------------------------
+
+
+def _config_diff(a: List[dict], b: List[dict]) -> Optional[Dict[str, Any]]:
+    """Changed config keys between the two runs' ``run_start`` snapshots
+    (None when either log predates the snapshot field)."""
+    ca = next((r.get("config") for r in a if r.get("kind") == "run_start"), None)
+    cb = next((r.get("config") for r in b if r.get("kind") == "run_start"), None)
+    if not isinstance(ca, dict) or not isinstance(cb, dict):
+        return None
+    changed = {
+        k: {"a": ca.get(k), "b": cb.get(k)}
+        for k in sorted(set(ca) | set(cb))
+        if ca.get(k) != cb.get(k)
+    }
+    return changed
+
+
+def _divergence_epoch(
+    epochs_a: Dict[int, Dict[str, float]],
+    epochs_b: Dict[int, Dict[str, float]],
+    metrics: Tuple[str, ...],
+    rtol: float,
+    atol: float,
+) -> Optional[Tuple[int, str, float, float]]:
+    """First common epoch where a watched metric differs beyond
+    ``atol + rtol * |a|`` -> (epoch, metric, value_a, value_b)."""
+    for epoch in sorted(set(epochs_a) & set(epochs_b)):
+        for metric in metrics:
+            va = epochs_a[epoch].get(metric)
+            vb = epochs_b[epoch].get(metric)
+            if va is None or vb is None:
+                continue
+            if not (math.isfinite(va) and math.isfinite(vb)):
+                if va != vb and not (
+                    math.isnan(va) and math.isnan(vb)
+                ):
+                    return epoch, metric, va, vb
+                continue
+            if abs(va - vb) > atol + rtol * abs(va):
+                return epoch, metric, va, vb
+    return None
+
+
+def cmd_diff(args) -> int:
+    rec_a, rec_b = _load(args.log_a), _load(args.log_b)
+    epochs_a, epochs_b = _epoch_scalars(rec_a), _epoch_scalars(rec_b)
+    common = sorted(set(epochs_a) & set(epochs_b))
+    watch = tuple(args.metric) if args.metric else DEFAULT_WATCH_METRICS
+    deltas: Dict[str, Dict[str, float]] = {}
+    if common:
+        shared_keys = sorted(
+            set.intersection(
+                *(set(epochs_a[e]) & set(epochs_b[e]) for e in common)
+            )
+        )
+        for key in shared_keys:
+            dv = [epochs_a[e][key] - epochs_b[e][key] for e in common]
+            finite = [d for d in dv if math.isfinite(d)]
+            deltas[key] = {
+                "max_abs_delta": max(abs(d) for d in finite) if finite else None,
+                "final_delta": dv[-1] if math.isfinite(dv[-1]) else None,
+                "nonfinite_epochs": sum(1 for d in dv if not math.isfinite(d)),
+            }
+    div = _divergence_epoch(epochs_a, epochs_b, watch, args.rtol, args.atol)
+    cfg_diff = _config_diff(rec_a, rec_b)
+    anomalies = {
+        "a": sum(1 for r in rec_a if r.get("kind") == "anomaly"),
+        "b": sum(1 for r in rec_b if r.get("kind") == "anomaly"),
+    }
+    payload = {
+        "log_a": args.log_a,
+        "log_b": args.log_b,
+        "common_epochs": common and [common[0], common[-1]] or None,
+        "watched_metrics": list(watch),
+        "divergence": (
+            {"epoch": div[0], "metric": div[1], "a": div[2], "b": div[3]}
+            if div else None
+        ),
+        "scalar_deltas": deltas,
+        "config_changes": cfg_diff,
+        "anomaly_counts": anomalies,
+    }
+    lines = [f"diff {args.log_a} vs {args.log_b}"]
+    if cfg_diff is None:
+        lines.append("  config: no run_start snapshot in one of the logs")
+    elif not cfg_diff:
+        lines.append("  config: identical")
+    else:
+        lines.append(f"  config: {len(cfg_diff)} key(s) differ")
+        for k, v in cfg_diff.items():
+            lines.append(f"    {k}: {v['a']!r} -> {v['b']!r}")
+    if not common:
+        lines.append("  no common epochs to compare")
+    else:
+        lines.append(f"  common epochs: {common[0]}..{common[-1]}")
+        if div:
+            lines.append(
+                f"  DIVERGED at epoch {div[0]} on {div[1]}: "
+                f"{div[2]:.6g} vs {div[3]:.6g}"
+            )
+        else:
+            lines.append(
+                "  watched metrics agree within tolerance "
+                f"(rtol={args.rtol}, atol={args.atol}): "
+                + ", ".join(watch)
+            )
+        ranked = sorted(
+            (
+                (k, d) for k, d in deltas.items()
+                if d["max_abs_delta"] is not None
+            ),
+            key=lambda kd: -kd[1]["max_abs_delta"],
+        )[:args.top]
+        for k, d in ranked:
+            lines.append(
+                f"    {k}: max|Δ|={d['max_abs_delta']:.6g} "
+                f"finalΔ={d['final_delta'] if d['final_delta'] is not None else 'nan'}"
+            )
+    if anomalies["a"] or anomalies["b"]:
+        lines.append(
+            f"  anomalies: {anomalies['a']} (a) vs {anomalies['b']} (b)"
+        )
+    _emit(payload, args.json, lines)
+    return 1 if (div and args.fail_on_divergence) else 0
+
+
+# -- validate ---------------------------------------------------------------
+
+
+def cmd_validate(args) -> int:
+    try:
+        n = validate_file(args.log)
+    except (ValueError, OSError) as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(f"{args.log}: {n} records, all schema-valid")
+    return 0
+
+
+# -- entry ------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="telemetry_cli",
+        description="Inspect / diff telemetry JSONL logs "
+                    "(logs/telemetry.jsonl)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add(name, fn, **kwargs):
+        sp = sub.add_parser(name, **kwargs)
+        sp.set_defaults(fn=fn)
+        sp.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+        return sp
+
+    sp = add("summary", cmd_summary, help="run overview")
+    sp.add_argument("log")
+    sp = add("epochs", cmd_epochs, help="per-epoch scalar table")
+    sp.add_argument("log")
+    sp.add_argument("--column", action="append", default=None,
+                    help="scalar column to show (repeatable; default: "
+                         "loss/accuracy/step-time columns present)")
+    sp = add("anomalies", cmd_anomalies,
+             help="anomaly / incident / watchdog_stall records")
+    sp.add_argument("log")
+    sp = add("tail", cmd_tail, help="last N records")
+    sp.add_argument("log")
+    sp.add_argument("-n", type=int, default=10)
+    sp.add_argument("--kind", default=None,
+                    help="only records of this kind")
+    sp = add("diff", cmd_diff, help="compare two runs' logs")
+    sp.add_argument("log_a")
+    sp.add_argument("log_b")
+    sp.add_argument("--metric", action="append", default=None,
+                    help="watched metric for the divergence epoch "
+                         "(repeatable; default: "
+                         + ", ".join(DEFAULT_WATCH_METRICS) + ")")
+    sp.add_argument("--rtol", type=float, default=1e-3)
+    sp.add_argument("--atol", type=float, default=1e-6)
+    sp.add_argument("--top", type=int, default=8,
+                    help="largest-delta metrics to print")
+    sp.add_argument("--fail-on-divergence", action="store_true",
+                    help="exit 1 when a watched metric diverges")
+    sp = add("validate", cmd_validate, help="schema-validate every record")
+    sp.add_argument("log")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:  # iter_records on a non-JSONL file
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,7 @@ def main() -> None:
         "BENCH_SKIP_EPOCH_BOUNDARY": "1",
         "BENCH_SKIP_INPUT_PIPELINE": "1",
         "BENCH_SKIP_TELEMETRY_OVERHEAD": "1",
+        "BENCH_SKIP_HEALTH_OVERHEAD": "1",
     }
     smoke = os.environ.get("BENCH_SWEEP_GRID") == "smoke"
     points = []

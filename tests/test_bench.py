@@ -14,12 +14,19 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_bench_prints_parsable_json_line():
+    """Slow lane: the 'bench exits 0 with a schema-valid line' duty runs on
+    every push via the dedicated CI bench-smoke job; this twin adds the
+    detailed per-measurement assertions (epoch boundary, input pipeline,
+    telemetry/health overhead, donation, HLO cost) on the full bench."""
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -77,6 +84,12 @@ def test_bench_prints_parsable_json_line():
     to = rec["telemetry_overhead"]
     assert to["off_ms_per_step"] > 0 and to["dynamics_ms_per_step"] > 0
     assert to["timed_steps"] >= 1
+    # on-device health-probe cost (health_level='monitor' vs off) is
+    # reported the same way
+    ho = rec["health_overhead"]
+    assert ho["off_ms_per_step"] > 0 and ho["monitor_ms_per_step"] > 0
+    assert ho["timed_steps"] >= 1
+    assert "overhead_pct" in ho
     assert rec["n_chips"] >= 1
     assert rec["dtype"] in ("float32", "bfloat16")
     # the step lowering is self-describing: conv impl + channel padding
@@ -183,6 +196,7 @@ def test_graft_entry_fn_jits_and_runs():
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+@pytest.mark.slow
 def test_bench_sweep_runs_and_ranks():
     """bench_sweep.py end-to-end on CPU with a clamped grid: the subprocess
     plumbing, per-point env assembly, error tolerance, and ranked table must

@@ -133,8 +133,12 @@ def test_gemm_is_task_batched_under_vmap():
     assert "feature_group_count" not in jaxpr
 
 
+@pytest.mark.slow
 def test_gemm_matches_lax_through_train_step(tiny_cfg, synthetic_batch):
-    """The task-batched GEMM lowering must match the native conv through the
+    """Slow lane (compiles a full second-order step per impl); the forward
+    and jaxpr-structure equivalence tests above stay in the fast lane.
+
+    The task-batched GEMM lowering must match the native conv through the
     full second-order outer step: bitwise-equal loss/accuracy is too strict
     across lowerings, so metrics compare to float tolerance and the
     meta-gradients to the same tolerances the remat/task-axis equivalence

@@ -67,6 +67,10 @@ def _xla_flops(cfg, second_order):
     return float(bench._cost_analysis_dict(compiled)["flops"])
 
 
+# slow lane: each variant lowers + compiles a full second-order train step
+# at conv-dominated width (~40s each on CPU), and the FLOPs model has no
+# fast-lane consumers — bench quotes MFU from it only on real runs
+@pytest.mark.slow
 @pytest.mark.parametrize("second_order", [True, False])
 def test_model_within_20pct_at_conv_dominated_width(second_order):
     cfg = _cfg(64, 5, max_pooling=True)
@@ -83,6 +87,7 @@ def test_model_within_20pct_at_conv_dominated_width(second_order):
         assert 0.5 < model / xla <= 1.05, (model, xla)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("max_pooling", [True, False])
 def test_model_is_conservative_at_small_width(max_pooling):
     """Both backbone branches: the model never OVER-counts (MFU reported

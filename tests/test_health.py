@@ -1,0 +1,726 @@
+"""Training-health monitor: the on-device anomaly probes (presence,
+correctness, bit-identity with probes off), the host-side AnomalyDetector
+rules, the flight recorder ring + incident dumps, and the end-to-end
+builder run that turns a forced anomaly into an on-disk incident."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import telemetry as tel
+from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
+from howtotrainyourmamlpytorch_tpu.telemetry.flight_recorder import (
+    INCIDENT_MANIFEST,
+    RING_FILENAME,
+    FlightRecorder,
+)
+from howtotrainyourmamlpytorch_tpu.telemetry.health import (
+    PROBE_KEYS,
+    AnomalyDetector,
+    HealthMonitor,
+)
+
+
+def _batch(cfg, seed=0):
+    from conftest import make_synthetic_batch
+
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=seed)
+    return x_s, x_t, y_s, y_t  # the facade's (x_s, x_t, y_s, y_t) order
+
+
+# -- config knobs -----------------------------------------------------------
+
+
+def test_config_validates_health_knobs(tiny_cfg):
+    with pytest.raises(ValueError, match="health_level"):
+        tiny_cfg.replace(health_level="bogus")
+    with pytest.raises(ValueError, match="health_patience"):
+        tiny_cfg.replace(health_patience=0)
+    with pytest.raises(ValueError, match="health_grad_norm_limit"):
+        tiny_cfg.replace(health_grad_norm_limit=-1.0)
+    with pytest.raises(ValueError, match="anomaly_loss_spike_factor"):
+        tiny_cfg.replace(anomaly_loss_spike_factor=-1.0)
+    with pytest.raises(ValueError, match="anomaly_ema_beta"):
+        tiny_cfg.replace(anomaly_ema_beta=1.0)
+    with pytest.raises(ValueError, match="flight_recorder_steps"):
+        tiny_cfg.replace(flight_recorder_steps=-1)
+    with pytest.raises(ValueError, match="max_state_dumps"):
+        tiny_cfg.replace(max_state_dumps=-2)
+    # 0 means "rule/recorder disabled", not an error
+    tiny_cfg.replace(
+        anomaly_loss_spike_factor=0.0, anomaly_grad_spike_factor=0.0,
+        flight_recorder_steps=0, max_state_dumps=0,
+        anomaly_cooldown_steps=0, anomaly_warmup_steps=0,
+    )
+
+
+# -- on-device probes -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_probes_ride_with_metrics(tiny_cfg):
+    cfg = tiny_cfg.replace(health_level="monitor")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    losses = model.run_train_iter(_batch(cfg), epoch=0)
+    health = losses["health"]
+    assert sorted(health) == sorted(PROBE_KEYS)
+    vals = {k: float(np.asarray(v)) for k, v in health.items()}
+    assert vals["nonfinite_grads"] == 0
+    assert vals["grad_norm"] > 0 and np.isfinite(vals["grad_norm"])
+    assert vals["update_norm"] > 0 and vals["param_norm"] > 0
+    np.testing.assert_allclose(
+        vals["loss"], float(np.asarray(losses["loss"])), rtol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_probes_grad_norm_matches_grads_fn(tiny_cfg):
+    """The probe's global grad norm equals the norm of the meta-gradients
+    the step actually applied (pre-clip), computed independently via
+    make_grads_fn."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml, msl
+
+    cfg = tiny_cfg.replace(health_level="monitor")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    x_s, x_t, y_s, y_t = _batch(cfg)
+    state_before = model.state
+    weights = msl.loss_weights_for(
+        cfg.number_of_training_steps_per_iter,
+        cfg.use_multi_step_loss_optimization, True, 0,
+        cfg.multi_step_loss_num_epochs,
+    )
+    _, grads = maml.make_grads_fn(cfg, second_order=True)(
+        state_before,
+        *(np.reshape(a, a.shape) for a in (
+            model._convert_batch((x_s, x_t, y_s, y_t))
+        )),
+        np.asarray(weights),
+    )
+    expected = np.sqrt(sum(
+        float(np.sum(np.square(np.asarray(g, np.float64))))
+        for g in jax.tree_util.tree_leaves(grads)
+    ))
+    losses = model.run_train_iter((x_s, x_t, y_s, y_t), epoch=0)
+    got = float(np.asarray(losses["health"]["grad_norm"]))
+    np.testing.assert_allclose(got, expected, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_probes_off_vs_on_bit_identical(tiny_cfg):
+    """health_level='monitor' must not change a single bit of the training
+    metrics or the learned parameters: the probes are pure reads of step
+    outputs, never inputs to the loss/update graph."""
+    cfg_on = tiny_cfg.replace(health_level="monitor")
+    m_off = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+    m_on = MAMLFewShotClassifier(cfg_on, use_mesh=False)
+    for step in range(2):
+        batch = _batch(tiny_cfg, seed=step)
+        l_off = m_off.run_train_iter(batch, epoch=0)
+        l_on = m_on.run_train_iter(batch, epoch=0)
+        assert "health" not in l_off
+        assert "health" in l_on
+        np.testing.assert_array_equal(
+            np.asarray(l_off["loss"]), np.asarray(l_on["loss"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(l_off["accuracy"]), np.asarray(l_on["accuracy"])
+        )
+    for key in m_off.state.net:
+        np.testing.assert_array_equal(
+            np.asarray(m_off.state.net[key]), np.asarray(m_on.state.net[key]),
+            err_msg=key,
+        )
+
+
+@pytest.mark.slow
+def test_probes_detect_injected_nan(tiny_cfg):
+    """A NaN in the input pixels must surface as non-finite probe values —
+    the exact signal the epoch-granular CSV can never carry."""
+    cfg = tiny_cfg.replace(health_level="monitor")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    x_s, x_t, y_s, y_t = _batch(cfg)
+    x_bad = np.array(x_s)
+    x_bad[0, 0, 0, 0, 0, 0] = np.nan
+    losses = model.run_train_iter((x_bad, x_t, y_s, y_t), epoch=0)
+    health = {k: float(np.asarray(v)) for k, v in losses["health"].items()}
+    assert health["nonfinite_grads"] > 0
+    assert not np.isfinite(health["loss"])
+
+
+@pytest.mark.slow
+def test_probes_chunked_dispatch_stack(tiny_cfg):
+    """steps_per_dispatch>1: probes come back (k,)-stacked from the fused
+    scan, one entry per iteration."""
+    cfg = tiny_cfg.replace(health_level="monitor")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    batches = [_batch(cfg, seed=s) for s in range(3)]
+    losses = model.run_train_iters(batches, epoch=0)
+    health = losses["health"]
+    for key in PROBE_KEYS:
+        assert np.asarray(health[key]).shape == (3,), key
+
+
+@pytest.mark.slow
+def test_eval_has_no_probes(tiny_cfg):
+    cfg = tiny_cfg.replace(health_level="monitor")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    losses, _ = model.run_validation_iter(_batch(cfg))
+    assert "health" not in losses
+
+
+# -- AnomalyDetector --------------------------------------------------------
+
+
+def _entry(loss=1.0, grad_norm=1.0, nonfinite=0, update_norm=0.01,
+           param_norm=10.0):
+    return {
+        "loss": loss, "grad_norm": grad_norm, "nonfinite_grads": nonfinite,
+        "update_norm": update_norm, "param_norm": param_norm,
+    }
+
+
+def test_detector_nonfinite_rules_always_armed():
+    det = AnomalyDetector(warmup_steps=100, cooldown_steps=0)
+    assert det.update(0, _entry()) == []
+    reasons = {a["reason"] for a in det.update(1, _entry(nonfinite=7))}
+    assert reasons == {"nonfinite_grads"}
+    reasons = {a["reason"] for a in det.update(2, _entry(loss=float("nan")))}
+    assert reasons == {"nonfinite_loss"}
+
+
+def test_detector_spike_rules_need_warmup_and_fire():
+    det = AnomalyDetector(
+        loss_spike_factor=3.0, grad_spike_factor=3.0,
+        ema_beta=0.5, warmup_steps=3, cooldown_steps=0,
+    )
+    # during warmup nothing fires, even on a 100x jump
+    for i in range(3):
+        assert det.update(i, _entry(loss=1.0, grad_norm=1.0)) == []
+    assert det.update(3, _entry(loss=100.0, grad_norm=1.0)) != []
+    # the spike folded into the EMA; a return to baseline stays quiet
+    assert det.update(4, _entry(loss=1.0, grad_norm=1.0)) == []
+    out = det.update(5, _entry(loss=1.0, grad_norm=500.0))
+    assert [a["reason"] for a in out] == ["grad_norm_spike"]
+    assert out[0]["value"] == 500.0 and out[0]["threshold"] > 0
+
+
+def test_detector_zero_factor_disables_spike_rule():
+    det = AnomalyDetector(
+        loss_spike_factor=0.0, grad_spike_factor=0.0,
+        warmup_steps=0, cooldown_steps=0,
+    )
+    for i in range(5):
+        det.update(i, _entry(loss=1.0))
+    assert det.update(5, _entry(loss=1e9, grad_norm=1e9)) == []
+
+
+def test_detector_cooldown_suppresses_per_reason():
+    det = AnomalyDetector(warmup_steps=0, cooldown_steps=10)
+    assert det.update(0, _entry(nonfinite=1)) != []
+    # same reason inside the window: suppressed
+    assert det.update(5, _entry(nonfinite=1)) == []
+    # a DIFFERENT reason still fires inside the window
+    assert [a["reason"] for a in det.update(6, _entry(
+        nonfinite=1, loss=float("inf")))] == ["nonfinite_loss"]
+    # window elapsed: fires again
+    assert det.update(10, _entry(nonfinite=1)) != []
+
+
+def test_detector_update_ratio_ceiling():
+    det = AnomalyDetector(update_ratio_max=0.1, warmup_steps=0,
+                          cooldown_steps=0)
+    assert det.update(0, _entry(update_norm=0.5, param_norm=10.0)) == []
+    out = det.update(1, _entry(update_norm=5.0, param_norm=10.0))
+    assert [a["reason"] for a in out] == ["update_ratio"]
+
+
+def test_detector_grad_norm_limit_is_absolute_and_warmup_free():
+    """Unlike the EMA spike rule, the absolute ceiling fires on the very
+    first observation — a run whose gradients are already huge at step 0
+    has no sane baseline to be relative to."""
+    det = AnomalyDetector(grad_spike_factor=0.0, grad_norm_limit=100.0,
+                          warmup_steps=50, cooldown_steps=0)
+    out = det.update(0, _entry(grad_norm=150.0))
+    assert [a["reason"] for a in out] == ["grad_norm_limit"]
+    assert out[0]["value"] == 150.0 and out[0]["threshold"] == 100.0
+    assert det.update(1, _entry(grad_norm=50.0)) == []
+    # a NaN norm is the nonfinite rules' job, not a limit breach
+    out = det.update(2, _entry(grad_norm=float("nan"), nonfinite=1))
+    assert [a["reason"] for a in out] == ["nonfinite_grads"]
+
+
+def test_detector_catches_overflowed_grad_norm():
+    """Finite gradient elements whose f32 sum-of-squares reduction
+    overflows to inf: no element-level rule sees it (nonfinite_grads=0,
+    loss finite) and every value-gated rule skips non-finite input, so a
+    dedicated always-armed rule must fire — else a catastrophically
+    exploded run trains to completion silently."""
+    det = AnomalyDetector(warmup_steps=100, cooldown_steps=0)
+    out = det.update(0, _entry(grad_norm=float("inf")))
+    assert [a["reason"] for a in out] == ["nonfinite_grad_norm"]
+    assert det.anomalous_iterations == 1
+    # with non-finite ELEMENTS present, nonfinite_grads owns the report
+    out = det.update(1, _entry(grad_norm=float("nan"), nonfinite=3))
+    assert [a["reason"] for a in out] == ["nonfinite_grads"]
+    # an entry without the probe key (foreign payload) stays quiet
+    assert det.update(2, {"loss": 1.0}) == []
+
+
+def test_detector_counts_anomalous_iterations_through_cooldown():
+    """halt patience counts iterations where a rule condition HELD, so the
+    per-reason report cooldown can never stretch the halt decision."""
+    det = AnomalyDetector(warmup_steps=0, cooldown_steps=1000)
+    assert det.update(0, _entry(nonfinite=1)) != []   # reported
+    assert det.update(1, _entry(nonfinite=1)) == []   # suppressed...
+    assert det.update(2, _entry(nonfinite=1)) == []   # ...and suppressed
+    assert det.anomalous_iterations == 3              # ...but all counted
+    det.update(3, _entry())
+    assert det.anomalous_iterations == 3
+
+
+def test_detector_nan_does_not_poison_ema():
+    det = AnomalyDetector(loss_spike_factor=3.0, ema_beta=0.5,
+                          warmup_steps=0, cooldown_steps=0)
+    det.update(0, _entry(loss=1.0))
+    det.update(1, _entry(loss=float("nan")))  # fires nonfinite_loss only
+    assert det.ema("loss") == 1.0  # NaN never folded in
+    # recovery to baseline is judged against the clean EMA
+    assert det.update(2, _entry(loss=1.0)) == []
+
+
+# -- FlightRecorder ---------------------------------------------------------
+
+
+def test_recorder_ring_wraps(tmp_path):
+    rec = FlightRecorder(4, str(tmp_path / "inc"), cooldown_steps=0)
+    for i in range(10):
+        rec.record_step({"iter": i})
+    ring = rec.snapshot()
+    assert [e["iter"] for e in ring] == [6, 7, 8, 9]
+
+
+def test_recorder_dump_writes_ring_and_manifest(tmp_path):
+    rec = FlightRecorder(8, str(tmp_path / "inc"), cooldown_steps=0)
+    for i in range(3):
+        rec.record_step({"iter": i, "loss": float(i)})
+    rec.note_event("epoch", epoch=1, val_accuracy_mean=0.5)
+    path = rec.dump("nonfinite_grads", 3, details={"anomaly": {"value": 7}})
+    assert path is not None and os.path.isdir(path)
+    with open(os.path.join(path, RING_FILENAME)) as f:
+        entries = [json.loads(line) for line in f]
+    assert len(entries) == 4
+    assert entries[0]["iter"] == 0 and entries[-1]["event"] == "epoch"
+    with open(os.path.join(path, INCIDENT_MANIFEST)) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "nonfinite_grads"
+    assert manifest["iter"] == 3 and manifest["ring_entries"] == 4
+    assert manifest["state_dumped"] is False
+    assert manifest["details"]["anomaly"]["value"] == 7
+
+
+def test_recorder_cooldown_and_state_dump_cap(tmp_path):
+    dumps = []
+    rec = FlightRecorder(8, str(tmp_path / "inc"), max_state_dumps=1,
+                         cooldown_steps=10)
+    p1 = rec.dump("loss_spike", 0, state_dump_fn=dumps.append)
+    assert p1 is not None and dumps == [p1]
+    # inside the cooldown window: no dump at all
+    assert rec.dump("loss_spike", 5, state_dump_fn=dumps.append) is None
+    # window elapsed: incident written, but the state-dump cap is spent
+    p2 = rec.dump("loss_spike", 10, state_dump_fn=dumps.append)
+    assert p2 is not None and dumps == [p1]
+    with open(os.path.join(p2, INCIDENT_MANIFEST)) as f:
+        assert json.load(f)["state_dumped"] is False
+
+
+def test_recorder_force_dump_bypasses_cooldown(tmp_path):
+    """A watchdog stall (or the halt escalation) right after a routine
+    anomaly dump must still produce its incident: force=True bypasses the
+    reason-agnostic cooldown, never the disabled gate."""
+    rec = FlightRecorder(8, str(tmp_path / "inc"), cooldown_steps=200)
+    assert rec.dump("loss_spike", 0) is not None
+    assert rec.dump("watchdog_stall", 50) is None  # sanity: window active
+    path = rec.dump("watchdog_stall", 50, force=True)
+    assert path is not None and os.path.isdir(path)
+    off = FlightRecorder(0, str(tmp_path / "inc2"))
+    assert off.dump("watchdog_stall", 0, force=True) is None
+
+
+def test_recorder_state_dump_failure_is_recorded_not_raised(tmp_path):
+    def boom(path):
+        raise RuntimeError("device wedged")
+
+    rec = FlightRecorder(8, str(tmp_path / "inc"), cooldown_steps=0)
+    path = rec.dump("nonfinite_loss", 1, state_dump_fn=boom)
+    assert path is not None
+    with open(os.path.join(path, INCIDENT_MANIFEST)) as f:
+        manifest = json.load(f)
+    assert manifest["state_dumped"] is False
+    assert "device wedged" in manifest["state_error"]
+
+
+def test_recorder_disabled_cases(tmp_path):
+    assert not FlightRecorder(0, str(tmp_path)).enabled
+    assert not FlightRecorder(8, str(tmp_path), is_primary=False).enabled
+    rec = FlightRecorder(0, str(tmp_path / "inc"))
+    rec.record_step({"iter": 0})
+    assert rec.snapshot() == []
+    assert rec.dump("x", 0) is None
+
+
+def test_recorder_never_clobbers_same_incident_name(tmp_path):
+    rec = FlightRecorder(4, str(tmp_path / "inc"), cooldown_steps=0)
+    p1 = rec.dump("loss_spike", 7)
+    p2 = rec.dump("loss_spike", 7)
+    assert p1 != p2 and os.path.isdir(p1) and os.path.isdir(p2)
+
+
+# -- HealthMonitor ----------------------------------------------------------
+
+
+def test_monitor_one_dispatch_lag_and_flush(tiny_cfg):
+    cfg = tiny_cfg.replace(health_level="monitor", anomaly_warmup_steps=0,
+                           anomaly_cooldown_steps=0)
+    mon = HealthMonitor(cfg)
+    mon.observe(0, {k: np.float32(1.0) for k in PROBE_KEYS})
+    assert mon.steps_seen == 0  # deferred: nothing evaluated yet
+    mon.observe(1, {k: np.float32(1.0) for k in PROBE_KEYS})
+    assert mon.steps_seen == 1  # the previous dispatch got evaluated
+    mon.flush()
+    assert mon.steps_seen == 2
+    mon.flush()  # idempotent
+    assert mon.steps_seen == 2
+
+
+def test_monitor_splits_stacked_payloads_and_reports(tiny_cfg, tmp_path):
+    cfg = tiny_cfg.replace(
+        health_level="monitor", telemetry_level="scalars",
+        anomaly_warmup_steps=0, anomaly_cooldown_steps=0,
+    )
+    t = tel.Telemetry(cfg, str(tmp_path))
+    rec = FlightRecorder(16, str(tmp_path / "inc"), cooldown_steps=0)
+    mon = HealthMonitor(cfg, telemetry=t, recorder=rec)
+    clean = {
+        "loss": np.ones(3, np.float32),
+        "grad_norm": np.ones(3, np.float32),
+        "nonfinite_grads": np.zeros(3, np.int32),
+        "update_norm": np.full(3, 0.01, np.float32),
+        "param_norm": np.full(3, 10.0, np.float32),
+    }
+    bad = {k: np.array(v) for k, v in clean.items()}
+    bad["nonfinite_grads"] = np.array([0, 5, 0], np.int32)
+    mon.observe(0, clean)
+    mon.observe(3, bad)  # evaluates the clean chunk
+    mon.flush()          # evaluates the bad chunk -> anomaly at iter 4
+    t.close()
+    assert mon.steps_seen == 6
+    assert mon.anomaly_count == 1
+    recs = list(tel.iter_records(
+        os.path.join(str(tmp_path), tel.TELEMETRY_FILENAME)))
+    anoms = [r for r in recs if r["kind"] == "anomaly"]
+    incidents = [r for r in recs if r["kind"] == "incident"]
+    assert len(anoms) == 1 and anoms[0]["iter"] == 4
+    assert anoms[0]["reason"] == "nonfinite_grads"
+    assert len(incidents) == 1 and os.path.isdir(incidents[0]["path"])
+    for r in recs:
+        tel.validate_record(r)
+    # the ring inside the incident carries the clean lead-up steps
+    with open(os.path.join(incidents[0]["path"], RING_FILENAME)) as f:
+        ring = [json.loads(line) for line in f]
+    assert [e["iter"] for e in ring if "iter" in e][:3] == [0, 1, 2]
+
+
+def test_monitor_survives_incident_dump_io_failure(tiny_cfg, tmp_path):
+    """A disk-full/permission error writing the incident directory is
+    best-effort forensics: it must not unwind into the train loop and kill
+    a monitor-only run (the anomaly itself is still counted/reported)."""
+    cfg = tiny_cfg.replace(health_level="monitor", anomaly_warmup_steps=0,
+                           anomaly_cooldown_steps=0)
+    rec = FlightRecorder(8, str(tmp_path / "inc"), cooldown_steps=0)
+    rec.dump = lambda *a, **k: (_ for _ in ()).throw(OSError("disk full"))
+    mon = HealthMonitor(cfg, recorder=rec)
+    bad = {k: np.float32(1.0) for k in PROBE_KEYS}
+    bad["nonfinite_grads"] = np.int32(3)
+    mon.observe(0, bad)
+    mon.flush()  # must not raise
+    assert mon.anomaly_count == 1
+
+
+def test_monitor_handles_multihost_list_payload(tiny_cfg):
+    cfg = tiny_cfg.replace(health_level="monitor", anomaly_warmup_steps=0)
+    mon = HealthMonitor(cfg)
+    payload = [
+        {k: np.float32(1.0) for k in PROBE_KEYS},
+        {k: np.float32(2.0) for k in PROBE_KEYS},
+    ]
+    mon.observe(0, payload)
+    mon.flush()
+    assert mon.steps_seen == 2
+
+
+def test_monitor_halt_latches_on_patience(tiny_cfg):
+    cfg = tiny_cfg.replace(
+        health_level="halt", health_patience=2,
+        anomaly_warmup_steps=0, anomaly_cooldown_steps=0,
+    )
+    mon = HealthMonitor(cfg)
+    clean = {k: np.float32(1.0) for k in PROBE_KEYS}
+    clean["nonfinite_grads"] = np.int32(0)
+    bad = dict(clean, nonfinite_grads=np.int32(3))
+    mon.observe(0, bad)
+    mon.observe(1, clean)  # evaluates the first bad step: 1 < patience
+    assert not mon.should_halt
+    mon.observe(2, bad)    # evaluates clean
+    assert not mon.should_halt
+    mon.observe(3, clean)  # evaluates the second bad step: latch
+    assert mon.should_halt
+    assert mon.halt_anomaly["iter"] == 2
+    assert mon.halt_anomaly["reason"] == "nonfinite_grads"
+
+
+def test_monitor_halt_latches_even_when_cooldown_suppresses_report(tiny_cfg):
+    cfg = tiny_cfg.replace(
+        health_level="halt", health_patience=2,
+        anomaly_warmup_steps=0, anomaly_cooldown_steps=1000,
+    )
+    mon = HealthMonitor(cfg)
+    bad = {
+        **{k: np.float32(1.0) for k in PROBE_KEYS},
+        "nonfinite_grads": np.int32(1),
+    }
+    mon.observe(0, bad)
+    mon.observe(1, bad)
+    mon.flush()
+    assert mon.should_halt
+    # the latching iteration's report was cooldown-suppressed; the latch
+    # says so instead of inventing a rule
+    assert mon.halt_anomaly["reason"] == "anomaly_under_cooldown"
+    assert mon.anomaly_count == 1  # only the first was reported
+
+
+def test_monitor_level_monitor_never_latches_halt(tiny_cfg):
+    cfg = tiny_cfg.replace(
+        health_level="monitor", health_patience=1,
+        anomaly_warmup_steps=0, anomaly_cooldown_steps=0,
+    )
+    mon = HealthMonitor(cfg)
+    bad = {
+        **{k: np.float32(1.0) for k in PROBE_KEYS},
+        "nonfinite_grads": np.int32(1),
+    }
+    for i in range(3):
+        mon.observe(i, bad)
+    mon.flush()
+    assert mon.anomaly_count == 3 and not mon.should_halt
+
+
+# -- end-to-end through the builder ----------------------------------------
+
+
+@pytest.mark.slow
+def test_builder_health_e2e_forced_anomaly(tmp_path):
+    """A tiny probes-on train with a hair-trigger spike rule: the run must
+    finish normally AND leave behind (a) anomaly + incident records in a
+    schema-valid telemetry log, (b) an incident directory whose ring and
+    manifest parse, and (c) a state dump that orbax can restore."""
+    from test_e2e_presplit import _write_presplit_rgb
+
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root))
+    cfg = MAMLConfig(
+        experiment_name=str(tmp_path / "exp_health"),
+        dataset_name="mini_imagenet_full_size",
+        dataset_path=str(data_root),
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=10, image_width=10, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+        batch_size=2, cnn_num_filters=4, num_stages=2, max_pooling=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True, second_order=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_epochs=2, total_iter_per_epoch=4, num_evaluation_tasks=4,
+        total_epochs_before_pause=100,
+        num_dataprovider_workers=2, cache_dir=str(tmp_path / "cache"),
+        use_mmap_cache=True, use_remat=False, seed=0,
+        steps_per_dispatch=2,
+        eval_batches_per_dispatch=2,
+        telemetry_level="scalars",
+        health_level="monitor",
+        # hair trigger: every armed step's loss "spikes" over 1e-6 x EMA
+        anomaly_loss_spike_factor=1e-6,
+        anomaly_warmup_steps=1,
+        anomaly_cooldown_steps=0,
+        flight_recorder_steps=8,
+        max_state_dumps=1,
+    )
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    builder = ExperimentBuilder(
+        cfg, model, MetaLearningDataLoader,
+        experiment_root=str(tmp_path), verbose=False,
+    )
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+
+    log_path = os.path.join(builder.logs_filepath, tel.TELEMETRY_FILENAME)
+    assert tel.validate_file(log_path) > 0
+    recs = list(tel.iter_records(log_path))
+    kinds = [r["kind"] for r in recs]
+    assert "anomaly" in kinds and "incident" in kinds
+    # run_start carries the config snapshot telemetry_cli diff consumes
+    run_start = next(r for r in recs if r["kind"] == "run_start")
+    assert run_start["config"]["health_level"] == "monitor"
+    # the CSV stayed clean: probe keys never leak into the summary row
+    import csv
+
+    with open(os.path.join(builder.logs_filepath,
+                           "summary_statistics.csv")) as f:
+        header = next(csv.reader(f))
+    assert not any("health" in k or "grad_norm" in k for k in header)
+
+    incidents = [r for r in recs if r["kind"] == "incident"]
+    inc_dir = incidents[0]["path"]
+    assert os.path.isdir(inc_dir)
+    with open(os.path.join(inc_dir, INCIDENT_MANIFEST)) as f:
+        manifest = json.load(f)
+    assert manifest["state_dumped"] is True
+    with open(os.path.join(inc_dir, RING_FILENAME)) as f:
+        ring = [json.loads(line) for line in f]
+    assert ring  # the lead-up context made it to disk
+    # exactly one state dump (max_state_dumps=1) and it restores
+    state_dirs = [
+        r["path"] for r in incidents
+        if os.path.isdir(os.path.join(r["path"], "state"))
+    ]
+    assert len(state_dirs) == 1
+    import orbax.checkpoint as ocp
+
+    restored = ocp.StandardCheckpointer().restore(
+        os.path.join(os.path.abspath(state_dirs[0]), "state")
+    )
+    assert sorted(restored.keys()) == ["bn", "lslr", "net", "opt"]
+    with open(os.path.join(state_dirs[0], "experiment_state.json")) as f:
+        exp_state = json.load(f)
+    assert "current_iter" in exp_state
+
+
+@pytest.mark.slow
+def test_builder_halt_e2e_diverged_run(tmp_path):
+    """``health_level='halt'`` end-to-end forensics (the acceptance
+    criterion): a deliberately diverged run raises TrainingDivergedError
+    within health_patience iterations (plus the one-dispatch detection
+    lag), leaves a RESUMABLE train_model_emergency checkpoint, a forced
+    ``halt`` incident dump, and a schema-valid telemetry log that `cli
+    inspect` renders the anomaly timeline from."""
+    import subprocess
+    import sys as _sys
+
+    from test_e2e_presplit import _write_presplit_rgb
+
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.experiment.checkpoint import (
+        checkpoint_exists,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry import TrainingDivergedError
+
+    data_root = tmp_path / "mini_imagenet_full_size"
+    _write_presplit_rgb(str(data_root))
+    cfg = MAMLConfig(
+        experiment_name=str(tmp_path / "exp_halt"),
+        dataset_name="mini_imagenet_full_size",
+        dataset_path=str(data_root),
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=10, image_width=10, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+        batch_size=2, cnn_num_filters=4, num_stages=2, max_pooling=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True, second_order=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_epochs=2, total_iter_per_epoch=4, num_evaluation_tasks=4,
+        total_epochs_before_pause=100,
+        num_dataprovider_workers=2, cache_dir=str(tmp_path / "cache"),
+        use_mmap_cache=True, use_remat=False, seed=0,
+        steps_per_dispatch=2,
+        eval_batches_per_dispatch=2,
+        telemetry_level="scalars",
+        health_level="halt",
+        health_patience=1,
+        # hair trigger: every armed step's loss "spikes" over 1e-6 x EMA
+        anomaly_loss_spike_factor=1e-6,
+        anomaly_warmup_steps=1,
+        anomaly_cooldown_steps=0,
+        flight_recorder_steps=8,
+        max_state_dumps=1,
+    )
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    builder = ExperimentBuilder(
+        cfg, model, MetaLearningDataLoader,
+        experiment_root=str(tmp_path), verbose=False,
+    )
+    with pytest.raises(TrainingDivergedError) as exc_info:
+        builder.run_experiment()
+    err = exc_info.value
+    # halted within patience + the one-dispatch lag — nowhere near the
+    # configured 2 epochs x 4 iters of training
+    assert err.iter_at_halt is not None and err.iter_at_halt <= 4
+    assert int(builder.state["current_iter"]) < 8
+
+    # the emergency checkpoint exists and RESUMES through the normal path
+    assert err.checkpoint_path is not None
+    assert checkpoint_exists(
+        builder.saved_models_filepath, "train_model", "emergency"
+    )
+    exp_state = model.load_model(builder.saved_models_filepath, "emergency")
+    assert "current_iter" in exp_state
+
+    # the forced halt dump: ring + manifest naming the emergency checkpoint
+    assert err.dump_dir is not None and os.path.isdir(err.dump_dir)
+    with open(os.path.join(err.dump_dir, INCIDENT_MANIFEST)) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "halt"
+    assert manifest["details"]["emergency_checkpoint"] == err.checkpoint_path
+    assert os.path.isfile(os.path.join(err.dump_dir, RING_FILENAME))
+
+    # telemetry log: schema-valid, carries the anomaly + halt incident and
+    # the run_end marker (the teardown still flushed cleanly)
+    log_path = os.path.join(builder.logs_filepath, tel.TELEMETRY_FILENAME)
+    assert tel.validate_file(log_path) > 0
+    recs = list(tel.iter_records(log_path))
+    kinds = [r["kind"] for r in recs]
+    assert "anomaly" in kinds and "incident" in kinds and "run_end" in kinds
+    halt_incidents = [
+        r for r in recs
+        if r["kind"] == "incident" and r["reason"] == "halt"
+    ]
+    assert halt_incidents and halt_incidents[0]["path"] == err.dump_dir
+
+    # `cli inspect` renders the anomaly timeline from the produced log —
+    # through the jax-free dispatch path a laptop would use
+    for sub in (["summary"], ["anomalies"], ["validate"]):
+        out = subprocess.run(
+            [_sys.executable, "-m", "howtotrainyourmamlpytorch_tpu.cli",
+             "inspect", *sub, log_path],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, (sub, out.stderr[-2000:])
+    out = subprocess.run(
+        [_sys.executable, "-m", "howtotrainyourmamlpytorch_tpu.cli",
+         "inspect", "anomalies", log_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "anomaly" in out.stdout and "halt" in out.stdout

@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from howtotrainyourmamlpytorch_tpu.core import lslr
 
@@ -44,7 +45,10 @@ def test_sgd_update_math():
     np.testing.assert_allclose(out["w"], [0.95, 2.1], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_sgd_mode_equals_nonlearnable_lslr(tiny_cfg, synthetic_batch):
+    # slow lane: compiles two full second-order grads_fns; the SGD update
+    # math itself is pinned by the fast test_sgd_update_math above.
     # fixed-LR GD == LSLR with all LRs at init (the reference's unused
     # GradientDescentLearningRule vs LSLRGradientDescentLearningRule at init)
     from howtotrainyourmamlpytorch_tpu.core import maml, msl

@@ -142,8 +142,12 @@ def test_backbone_padded_bit_exact_through_bn(tiny_cfg, dtype_name):
         )
 
 
+@pytest.mark.slow
 def test_train_step_padded_metrics_exact_grads_close(tiny_cfg, synthetic_batch):
-    """One full second-order outer step with tile-rule channel padding on vs
+    """Slow lane (compiles two full second-order steps); the layer-level
+    bit-exactness tests above keep the padding rule pinned in the fast lane.
+
+    One full second-order outer step with tile-rule channel padding on vs
     off: loss/accuracy bit-identical, meta-gradients equal to float noise.
     Compared at the gradient level per the repo convention (make_grads_fn):
     post-Adam weights amplify float-reordering noise on ~zero-gradient

@@ -78,8 +78,11 @@ def test_telemetry_disabled_on_non_primary(tiny_cfg, tmp_path):
 def test_validate_record_rejects_bad_records():
     good = {"schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "run_end"}
     tel.validate_record(good)
-    with pytest.raises(ValueError, match="schema version"):
-        tel.validate_record({**good, "schema": 999})
+    # pre-MIN versions and non-integer versions mean corruption, not the
+    # future — rejected (NEWER versions are tolerated, tested below)
+    for bad_ver in (0, -1, 1.5, "2", None, True):
+        with pytest.raises(ValueError, match="schema version"):
+            tel.validate_record({**good, "schema": bad_ver})
     with pytest.raises(ValueError, match="unknown telemetry record kind"):
         tel.validate_record({**good, "kind": "bogus"})
     with pytest.raises(ValueError, match="missing required fields"):
@@ -293,6 +296,8 @@ def _stub_builder(tmp_path, cfg):
             device_memory_stats=lambda: {"store_bytes_expected": 0}
         ),
         _dyn_pending=[],
+        health_monitor=None,
+        flight_recorder=None,
         _log=lambda msg: None,
     )
     for name in ("pack_and_save_metrics", "_stream_metrics",
@@ -503,3 +508,89 @@ def test_config_validates_telemetry_knobs(tiny_cfg):
     with pytest.raises(ValueError, match="profile_start_step"):
         tiny_cfg.replace(profile_start_step=-2)
     assert tiny_cfg.replace(telemetry_level="scalars").telemetry_level == "scalars"
+
+
+# -- schema forward compatibility (v2) --------------------------------------
+
+
+def test_validate_accepts_v1_records():
+    """Every v1 record validates unchanged under the v2 validator — v2 is
+    pure additions (see the schema version history)."""
+    tel.validate_record({"schema": 1, "ts": 1.0, "kind": "run_end"})
+    tel.validate_record({
+        "schema": 1, "ts": 1.0, "kind": "epoch", "epoch": 0,
+        "scalars": {"train_loss_mean": 1.0},
+    })
+
+
+def test_validate_tolerates_newer_schema_versions():
+    """Records stamped with a FUTURE version get envelope-only checks:
+    unknown kinds and unknown fields must never make an old reader reject
+    a log it can still mostly use."""
+    tel.validate_record({
+        "schema": tel.SCHEMA_VERSION + 1, "ts": 1.0,
+        "kind": "quantum_flux", "novel_field": [1, 2, 3],
+    })
+    # the envelope is still enforced on future records
+    with pytest.raises(ValueError, match="'ts'"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION + 1, "kind": "quantum_flux",
+        })
+    with pytest.raises(ValueError, match="'kind'"):
+        tel.validate_record({"schema": tel.SCHEMA_VERSION + 1, "ts": 1.0})
+    # ...while the same unknown kind at the CURRENT version is rejected
+    with pytest.raises(ValueError, match="unknown telemetry record kind"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "quantum_flux",
+        })
+
+
+def test_validate_file_accepts_future_schema_fixture():
+    """The pinned mixed-version fixture: v1 records, an unknown v3 kind,
+    and v99 records that dropped/renamed required fields all pass — the
+    forward-compatibility contract, frozen as a file so a validator
+    refactor can't silently tighten it."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_future_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 5
+
+
+# -- non-finite masking is counted, not silent (sinks.make_record) ----------
+
+
+def test_make_record_counts_masked_nonfinite_values():
+    rec = tel.make_record(
+        "anomaly", iter=3, reason="nonfinite_loss",
+        value=float("nan"), threshold=0.0,
+        probes={"loss": float("inf"), "grad_norm": 1.0},
+    )
+    assert rec["value"] is None  # masked for spec-strict JSON...
+    assert rec["nonfinite_count"] == 2  # ...but counted, per field
+    assert rec["nonfinite_fields"] == {"value": 1, "probes": 1}
+    json.dumps(rec, allow_nan=False)
+    tel.validate_record(rec)
+
+
+def test_make_record_counts_per_array_nonfinites():
+    """Array payloads (the dynamics stacks) report per-field counts —
+    'which stack went NaN, and how badly' is answerable from JSONL."""
+    rec = tel.make_record(
+        "dynamics", iter_start=0, num_iters=2,
+        support_losses=np.array([1.0, np.nan, np.inf]),
+        target_losses=np.array([1.0, 2.0, 3.0]),
+        grad_norms={"layer0": np.array([np.nan, np.nan])},
+        lslr={"layer0": [0.1]},
+        msl_weights=[1.0],
+    )
+    assert rec["nonfinite_count"] == 4
+    assert rec["nonfinite_fields"] == {
+        "support_losses": 2, "grad_norms": 2,
+    }
+    tel.validate_record(rec)
+
+
+def test_make_record_omits_counts_when_all_finite():
+    rec = tel.make_record("epoch", epoch=0, scalars={"loss": 1.0})
+    assert "nonfinite_count" not in rec
+    assert "nonfinite_fields" not in rec

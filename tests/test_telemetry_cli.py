@@ -1,0 +1,225 @@
+"""The telemetry inspect/diff CLI (tools/telemetry_cli.py): every
+subcommand against synthetic schema-valid logs, the config-diff and
+divergence-epoch logic of ``diff``, exit codes, and the jax-free
+``python -m howtotrainyourmamlpytorch_tpu.cli inspect`` dispatch path."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.telemetry import make_record
+from howtotrainyourmamlpytorch_tpu.tools.telemetry_cli import main as cli_main
+
+
+def _write_log(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _run_records(val_accs, config=None, loss0=2.0, anomalies=()):
+    """A small schema-valid run log: run_start (+config snapshot), one
+    epoch record per val accuracy (losses decaying from ``loss0``),
+    dispatch/stream/device_memory records, optional anomaly records, and
+    the run_end marker."""
+    records = [make_record(
+        "run_start", experiment_name="exp", telemetry_level="scalars",
+        resume_iter=0, config=dict(config or {}),
+    )]
+    for e, acc in enumerate(val_accs):
+        records.append(make_record(
+            "epoch", epoch=e,
+            scalars={
+                "train_loss_mean": loss0 / (e + 1),
+                "val_accuracy_mean": acc,
+                "train_step_time_ms": 10.0 + e,
+            },
+        ))
+        records.append(make_record(
+            "dispatch", epoch=e,
+            train_step_time_ms=10.0 + e, train_step_time_p50_ms=9.0 + e,
+            train_step_time_p95_ms=12.0 + e,
+        ))
+        records.append(make_record(
+            "stream", epoch=e, batches=8, assembly_ms_per_batch=1.5,
+            stall_ms_per_batch=0.25, queue_depth_mean=3.0,
+        ))
+        records.append(make_record(
+            "device_memory", epoch=e, store_bytes_expected=0,
+            bytes_in_use=1 << 20, peak_bytes_in_use=2 << 20,
+        ))
+    for it, reason in anomalies:
+        records.append(make_record(
+            "anomaly", iter=it, reason=reason, value=1e9, threshold=10.0,
+        ))
+    records.append(make_record("run_end"))
+    return records
+
+
+def test_summary_text_and_json(tmp_path, capsys):
+    log = _write_log(tmp_path / "a.jsonl", _run_records(
+        [0.5, 0.8, 0.7], anomalies=[(7, "loss_spike")],
+    ))
+    assert cli_main(["summary", log]) == 0
+    text = capsys.readouterr().out
+    assert "epochs: 0..2" in text
+    assert "best 0.8000 @ epoch 1" in text and "final 0.7000" in text
+    assert "dispatch:" in text and "p95" in text
+    assert "stream:" in text and "hbm:" in text
+    assert "1 anomalies" in text
+
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["records"] == len(_run_records([0.5, 0.8, 0.7])) + 1
+    assert payload["best_val_epoch"] == 1
+    assert payload["anomalies"] == 1
+    assert payload["clean_shutdown"] is True
+    assert payload["dispatch_timing"]["train_step_time_p50_ms"] == 10.0
+    assert payload["stream"]["stall_ms_per_batch"] == 0.25
+    assert payload["device_memory"]["bytes_in_use"] == 1 << 20
+
+
+def test_summary_flags_unclean_shutdown(tmp_path, capsys):
+    recs = _run_records([0.5])[:-1]  # drop run_end: crashed / still running
+    log = _write_log(tmp_path / "crashed.jsonl", recs)
+    assert cli_main(["summary", log]) == 0
+    assert "no run_end marker" in capsys.readouterr().out
+
+
+def test_epochs_table(tmp_path, capsys):
+    log = _write_log(tmp_path / "a.jsonl", _run_records([0.5, 0.75]))
+    assert cli_main(["epochs", log]) == 0
+    text = capsys.readouterr().out
+    assert "val_accuracy_mean" in text and "0.7500" in text
+    assert cli_main(["epochs", log, "--json",
+                     "--column", "train_loss_mean"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["columns"] == ["train_loss_mean"]
+    assert payload["epochs"]["1"]["train_loss_mean"] == 1.0
+
+
+def test_anomalies_timeline(tmp_path, capsys):
+    records = _run_records([0.5], anomalies=[(3, "nonfinite_grads")])
+    records.append(make_record(
+        "incident", iter=3, reason="halt", path="/tmp/incident_dir",
+    ))
+    records.append(make_record(
+        "watchdog_stall", stage="train_dispatch",
+        seconds_since_progress=120.0, stacks={},
+    ))
+    log = _write_log(tmp_path / "a.jsonl", records)
+    assert cli_main(["anomalies", log]) == 0
+    text = capsys.readouterr().out
+    assert "nonfinite_grads" in text
+    assert "halt" in text and "/tmp/incident_dir" in text
+    assert "stall" in text and "train_dispatch" in text
+
+
+def test_anomalies_empty(tmp_path, capsys):
+    log = _write_log(tmp_path / "a.jsonl", _run_records([0.5]))
+    assert cli_main(["anomalies", log]) == 0
+    assert "no anomalies" in capsys.readouterr().out
+
+
+def test_tail_kind_filter(tmp_path, capsys):
+    log = _write_log(tmp_path / "a.jsonl", _run_records([0.1, 0.2, 0.3]))
+    assert cli_main(["tail", log, "-n", "2", "--kind", "epoch"]) == 0
+    lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert [r["epoch"] for r in lines] == [1, 2]
+
+
+def test_reader_tolerates_epoch_record_missing_epoch_field(tmp_path, capsys):
+    """Forward-compat contract: a future-schema epoch record that dropped
+    the 'epoch' field passes validate, so summary/epochs/diff must skip
+    it, not crash with a KeyError."""
+    recs = _run_records([0.5, 0.8])
+    recs.insert(-1, {"schema": 99, "ts": 1.0, "kind": "epoch",
+                     "scalars": {"train_loss_mean": 1.0}})
+    log = _write_log(tmp_path / "a.jsonl", recs)
+    assert cli_main(["validate", log]) == 0
+    for sub in (["summary", log], ["epochs", log], ["diff", log, log]):
+        assert cli_main(sub) == 0
+        capsys.readouterr()
+
+
+def test_tail_rejects_nonpositive_n(tmp_path, capsys):
+    log = _write_log(tmp_path / "a.jsonl", _run_records([0.1, 0.2, 0.3]))
+    assert cli_main(["tail", log, "-n", "0"]) == 2
+    assert cli_main(["tail", log, "-n", "-5"]) == 2
+    err = capsys.readouterr().err
+    assert "must be positive" in err
+
+
+def test_diff_identical_runs(tmp_path, capsys):
+    recs = _run_records([0.5, 0.6], config={"seed": 0})
+    log_a = _write_log(tmp_path / "a.jsonl", recs)
+    log_b = _write_log(tmp_path / "b.jsonl", recs)
+    assert cli_main(["diff", log_a, log_b]) == 0
+    text = capsys.readouterr().out
+    assert "config: identical" in text
+    assert "agree within tolerance" in text
+
+
+def test_diff_reports_divergence_and_config_change(tmp_path, capsys):
+    log_a = _write_log(tmp_path / "a.jsonl", _run_records(
+        [0.5, 0.6, 0.7], config={"seed": 0, "inner_lr": 0.1},
+    ))
+    # same epoch 0, diverging train loss from epoch 1 on, one config delta
+    log_b = _write_log(tmp_path / "b.jsonl", _run_records(
+        [0.5, 0.6, 0.7], config={"seed": 0, "inner_lr": 0.4}, loss0=4.0,
+    ))
+    assert cli_main(["diff", log_a, log_b, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["config_changes"] == {
+        "inner_lr": {"a": 0.1, "b": 0.4},
+    }
+    div = payload["divergence"]
+    assert div["metric"] == "train_loss_mean" and div["epoch"] == 0
+    assert payload["scalar_deltas"]["train_loss_mean"]["max_abs_delta"] == 2.0
+    # exit code 1 only on request
+    assert cli_main(["diff", log_a, log_b, "--fail-on-divergence"]) == 1
+
+
+def test_validate_exit_codes(tmp_path, capsys):
+    good = _write_log(tmp_path / "good.jsonl", _run_records([0.5]))
+    assert cli_main(["validate", good]) == 0
+    capsys.readouterr()
+    bad = _write_log(
+        tmp_path / "bad.jsonl",
+        [{"schema": 2, "ts": 1.0, "kind": "epoch"}],  # missing fields
+    )
+    assert cli_main(["validate", bad]) == 1
+
+
+def test_missing_file_is_exit_2(tmp_path, capsys):
+    assert cli_main(["summary", str(tmp_path / "nope.jsonl")]) == 2
+
+
+@pytest.mark.parametrize("sub", [["summary"], ["anomalies"], ["validate"]])
+def test_cli_inspect_dispatch_is_jax_free(tmp_path, sub):
+    """``python -m ...cli inspect`` must answer without importing jax —
+    the postmortem path for a laptop with a scp'd log and no accelerator
+    stack."""
+    log = _write_log(tmp_path / "a.jsonl", _run_records(
+        [0.5], anomalies=[(1, "loss_spike")],
+    ))
+    code = (
+        "import sys\n"
+        "from howtotrainyourmamlpytorch_tpu.cli import main\n"
+        "try:\n"
+        f"    main(['inspect', {sub[0]!r}, {log!r}])\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        "assert 'jax' not in sys.modules, 'inspect pulled in jax'\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
