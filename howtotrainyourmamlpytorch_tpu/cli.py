@@ -17,6 +17,13 @@ The ``inspect`` subcommand is the telemetry reader
 
 It is dispatched before any jax-importing module loads, so inspection
 works on a machine with nothing but the repo and numpy installed.
+
+Exit codes: 0 on success; ``resilience.PREEMPT_EXIT_CODE`` (75) when a
+SIGTERM/SIGINT preemption was drained gracefully (emergency checkpoint on
+disk — restart with ``continue_from_epoch=latest`` to resume at the exact
+iteration); nonzero tracebacks for crashes; 128+signum only for signals
+the graceful path could not handle (SIGKILL, or
+``handle_preemption_signals=false``).
 """
 
 from __future__ import annotations

@@ -281,6 +281,30 @@ class MAMLConfig:
     # per-run cap on anomaly-triggered incident dumps (each carries an
     # orbax state checkpoint — params + LSLR + BN + Adam moments)
     max_state_dumps: int = 3
+    # --- resilience (resilience/) ----------------------------------------
+    # deterministic fault injection into the named host-side I/O seams
+    # (resilience/faults.py — e.g. "ckpt_save:oserror@call=1x2,
+    # producer:raise@batch=10,signal:sigterm@iter=55"). '' (default)
+    # installs nothing: every seam is a single attribute check and the
+    # jitted device programs are bit-identical to a spec-free build
+    # (tested). The MAML_FAULT_SPEC env var supplies the spec when the
+    # field is empty (chaos CI drives subprocesses through it).
+    fault_spec: str = ""
+    # retry/backoff for the checkpoint + statistics I/O seams
+    # (resilience/retry.py): max attempts per write, first backoff, and
+    # the exponential factor. Backoff is deterministic (no jitter) so
+    # kill/resume equivalence tests and log diffs see the same sequence.
+    io_retry_attempts: int = 3
+    io_retry_backoff_s: float = 0.5
+    io_retry_backoff_factor: float = 2.0
+    # graceful preemption: install SIGTERM/SIGINT handlers for the duration
+    # of run_experiment; on a signal the builder finishes the in-flight
+    # dispatch, drains pending async checkpoints, writes a resumable
+    # train_model_emergency checkpoint (incl. the partial epoch's metric
+    # history) and exits with resilience.PREEMPT_EXIT_CODE. false keeps
+    # the process's default signal behaviour (die, lose up to an epoch).
+    handle_preemption_signals: bool = True
+
     # persistent XLA compilation cache: resumed runs (and repeated runs of
     # the same config) skip the 20-40s TPU compile of the train/eval steps.
     # 'auto' (default) => <experiment_dir>/xla_cache, resolved by the
@@ -442,6 +466,25 @@ class MAMLConfig:
                 f"profile_start_step must be >= 0, got "
                 f"{self.profile_start_step}"
             )
+        if self.io_retry_attempts < 1:
+            raise ValueError(
+                f"io_retry_attempts must be >= 1, got {self.io_retry_attempts}"
+            )
+        if self.io_retry_backoff_s < 0:
+            raise ValueError(
+                f"io_retry_backoff_s must be >= 0, got "
+                f"{self.io_retry_backoff_s}"
+            )
+        if self.io_retry_backoff_factor < 1.0:
+            raise ValueError(
+                f"io_retry_backoff_factor must be >= 1, got "
+                f"{self.io_retry_backoff_factor}"
+            )
+        # validated at config time so a typo'd spec fails the run with a
+        # grammar error before any training (or CI chaos matrix) happens
+        from .resilience.faults import parse_fault_spec
+
+        parse_fault_spec(self.fault_spec)
         if self.remat_policy not in ("full", "save_conv"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'save_conv', got "

@@ -30,10 +30,18 @@ from typing import Dict, Iterator, NamedTuple, Optional, Tuple, Union
 import numpy as np
 
 from ..config import MAMLConfig
+from ..resilience import faults
 from . import datasets as ds
 from .episodes import Episode, IndexEpisode, sample_episode, sample_episode_indices
 
 Batch = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+class ProducerCrashedError(RuntimeError):
+    """The background episode-producer thread died with an exception. The
+    original exception is chained (``__cause__``); every subsequent
+    ``get_*_batches`` pull re-raises so a run can never silently train on
+    a starved stream."""
 
 
 class IndexBatch(NamedTuple):
@@ -218,6 +226,12 @@ class MetaLearningDataLoader:
             "assembly_s": 0.0, "stall_s": 0.0, "depth_sum": 0.0, "batches": 0,
         }
         self._last_producer_thread: Optional[threading.Thread] = None
+        # a producer thread's death is latched here and re-raised from
+        # every subsequent batch pull (not only the generator that owned
+        # the thread): a dead producer means the episode stream is broken
+        # for good, and the consumer must fail loudly rather than block on
+        # an empty queue until the watchdog fires
+        self._producer_error: Optional[BaseException] = None
         self.continue_from_iter(current_iter)
 
     def pop_stream_stats(self) -> Dict[str, float]:
@@ -294,6 +308,10 @@ class MetaLearningDataLoader:
                     for b in range(total_batches):
                         if stop.is_set():
                             return
+                        # injectable seam (resilience/faults.py): fires in
+                        # THIS thread, once per produced batch — a 'raise'
+                        # fault here is the dead-producer scenario
+                        faults.fire("producer")
                         # this host's slice of the global batch's task range
                         idxs = range(b * tpb + lo, b * tpb + hi)
                         t0 = time.perf_counter()
@@ -309,6 +327,12 @@ class MetaLearningDataLoader:
                             self.stream_stats["batches"] += 1
                 put(None)
             except BaseException as exc:  # surface worker errors to consumer
+                # latch FIRST: even if the enqueue below never lands (full
+                # queue + consumer mid-dispatch, or a consumer that only
+                # returns after this thread is gone), the next pull — of
+                # this generator or any later one — sees the error instead
+                # of blocking on an empty queue until the watchdog fires
+                self._producer_error = exc
                 put(exc)
 
         thread = threading.Thread(target=producer, daemon=True)
@@ -316,18 +340,48 @@ class MetaLearningDataLoader:
         thread.start()
         try:
             while True:
-                item = out.get()
+                try:
+                    # timed poll, NOT a bare blocking get: a producer that
+                    # died between enqueues (or whose error enqueue lost the
+                    # race) would otherwise park the consumer forever
+                    item = out.get(timeout=0.2)
+                except queue.Empty:
+                    if self._producer_error is not None:
+                        self._raise_producer_error()
+                    if not thread.is_alive():
+                        # died without latching anything (e.g. killed
+                        # interpreter-side): still never block forever
+                        raise ProducerCrashedError(
+                            f"episode producer thread for set {set_name!r} "
+                            "died without delivering a batch or an error"
+                        )
+                    continue
                 if item is None:
                     return
                 if isinstance(item, BaseException):
-                    raise item
+                    self._producer_error = item
+                    self._raise_producer_error()
                 yield item
         finally:
             stop.set()
 
+    def _raise_producer_error(self):
+        exc = self._producer_error
+        raise ProducerCrashedError(
+            f"episode producer thread crashed: {exc!r}"
+        ) from exc
+
+    def _check_producer(self) -> None:
+        """Re-raise a latched producer death at the next stream request —
+        the consumer-facing half of the dead-producer fix (see
+        ``_producer_error``)."""
+        if self._producer_error is not None:
+            self._raise_producer_error()
+
     def get_train_batches(
         self, total_batches: int, augment_images: bool = False
     ) -> Iterator[AnyBatch]:
+        self._check_producer()
         self.dataset.update_train_seed(self.total_train_iters_produced)
         # advanced once per generator CALL, not per batch — reference quirk
         # the resume arithmetic depends on (data.py:598-602)
@@ -337,9 +391,11 @@ class MetaLearningDataLoader:
     def get_val_batches(
         self, total_batches: int, augment_images: bool = False
     ) -> Iterator[AnyBatch]:
+        self._check_producer()
         return self._batches("val", total_batches, augment_images)
 
     def get_test_batches(
         self, total_batches: int, augment_images: bool = False
     ) -> Iterator[AnyBatch]:
+        self._check_producer()
         return self._batches("test", total_batches, augment_images)

@@ -24,13 +24,22 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..config import MAMLConfig
+from ..resilience import (
+    PREEMPT_EXIT_CODE,
+    PreemptedError,
+    RetriesExhaustedError,
+    RetryPolicy,
+    faults,
+)
 from ..telemetry import FlightRecorder, HealthMonitor, Telemetry, Watchdog
 from ..utils.profiling import StepTimer, TraceWindow
 from ..utils.storage import (
@@ -38,7 +47,12 @@ from ..utils.storage import (
     save_statistics,
     save_to_json,
 )
-from .checkpoint import checkpoint_exists, remove_checkpoint
+from .checkpoint import (
+    checkpoint_exists,
+    peek_experiment_state,
+    remove_checkpoint,
+    wait_for_pending,
+)
 from .system import MAMLFewShotClassifier
 
 
@@ -54,6 +68,23 @@ class ExperimentBuilder:
         self.cfg = cfg
         self.model = model
         self.verbose = verbose
+        # fault injection (resilience/faults.py): installed process-wide
+        # BEFORE any I/O seam below can run, from the config knob or (when
+        # empty) the MAML_FAULT_SPEC env var the chaos CI drives
+        # subprocesses through; empty installs nothing and every seam is a
+        # single attribute check
+        faults.install(
+            cfg.fault_spec or os.environ.get("MAML_FAULT_SPEC", "")
+        )
+        # retry/backoff for the checkpoint + statistics I/O seams; the
+        # observer turns every failed attempt into a telemetry `retry`
+        # record + flight-recorder note (wired below, after telemetry
+        # exists — events before that go to stderr only)
+        self.retry = RetryPolicy.from_config(cfg, observer=self._on_retry)
+        # preemption: the signal number latched by the SIGTERM/SIGINT
+        # handler run_experiment installs; drained at the next train
+        # dispatch boundary (_preempt_exit)
+        self._preempt_signum: Optional[int] = None
         (
             self.saved_models_filepath,
             self.logs_filepath,
@@ -90,11 +121,27 @@ class ExperimentBuilder:
         if cont == "from_scratch":
             self.create_summary_csv = True
         elif cont == "latest":
-            if checkpoint_exists(self.saved_models_filepath, "train_model", "latest"):
-                self.state = self.model.load_model(self.saved_models_filepath, "latest")
+            resume_idx = self._pick_latest_resume_point()
+            if resume_idx is not None:
+                # transient restore failures retried; corruption surfaces
+                # as CheckpointCorruptError naming the surviving fallbacks
+                self.state = self.retry.call(
+                    lambda: self.model.load_model(
+                        self.saved_models_filepath, resume_idx
+                    ),
+                    site="ckpt_restore",
+                )
+                self._rehydrate_inflight()
                 self.start_epoch = int(
                     self.state["current_iter"] // cfg.total_iter_per_epoch
                 )
+                if resume_idx == "emergency":
+                    self._log(
+                        "[resilience] resuming from the preemption "
+                        "emergency checkpoint at iter "
+                        f"{int(self.state['current_iter'])} (newer than "
+                        "'latest')"
+                    )
             else:
                 self.create_summary_csv = True
         elif int(cont) >= 0:
@@ -113,11 +160,16 @@ class ExperimentBuilder:
                     "continue_from_epoch='latest' or from a surviving "
                     "epoch checkpoint."
                 )
-            self.state = self.model.load_model(self.saved_models_filepath, int(cont))
+            self.state = self.retry.call(
+                lambda: self.model.load_model(
+                    self.saved_models_filepath, int(cont)
+                ),
+                site="ckpt_restore",
+            )
+            self._rehydrate_inflight()
             self.start_epoch = int(
                 self.state["current_iter"] // cfg.total_iter_per_epoch
             )
-
         # data stream fast-forwarded to the resume point
         # (experiment_builder.py:53)
         self.data = data_loader_cls(
@@ -155,6 +207,12 @@ class ExperimentBuilder:
         import jax
 
         self.is_primary = jax.process_index() == 0
+        if not self.create_summary_csv:
+            # resumed: drop CSV rows from epochs beyond the checkpoint — a
+            # killed run can have appended the row for an epoch whose
+            # checkpoint never finalized; the resumed run re-trains that
+            # epoch and would otherwise append a contradicting duplicate
+            self._truncate_stats_to_resume_point()
         # structured telemetry (telemetry/): JSONL event log + optional
         # TensorBoard, no-op at telemetry_level='off' / non-primary hosts
         self.telemetry = Telemetry(
@@ -297,6 +355,309 @@ class ExperimentBuilder:
         # values may be device arrays; conversion is deferred to summary time
         for key, value in losses.items():
             total_losses.setdefault(key, []).append(value)
+
+    # -- resilience plumbing (resilience/) ---------------------------------
+
+    def _pick_latest_resume_point(self) -> Optional[str]:
+        """Resolve ``continue_from_epoch='latest'`` to an actual checkpoint:
+        ``'emergency'`` when a *preemption* emergency checkpoint is newer
+        than ``latest`` (a SIGTERM mid-epoch saved more progress than the
+        last epoch boundary), ``'latest'`` otherwise, None when neither
+        exists. Only preemption emergencies are auto-resumed — a
+        ``health_level='halt'`` emergency is the *divergent* state, kept
+        for postmortem and never silently trained on."""
+        have_latest = checkpoint_exists(
+            self.saved_models_filepath, "train_model", "latest"
+        )
+        emerg = peek_experiment_state(
+            self.saved_models_filepath, "train_model", "emergency"
+        )
+        if (
+            emerg is not None
+            and emerg.get("emergency_reason") == "preemption"
+            and checkpoint_exists(
+                self.saved_models_filepath, "train_model", "emergency"
+            )
+        ):
+            latest_iter = -1
+            if have_latest:
+                latest_state = peek_experiment_state(
+                    self.saved_models_filepath, "train_model", "latest"
+                )
+                if latest_state is not None:
+                    latest_iter = int(latest_state.get("current_iter", -1))
+            if int(emerg.get("current_iter", -1)) > latest_iter:
+                return "emergency"
+        return "latest" if have_latest else None
+
+    def _rehydrate_inflight(self) -> None:
+        """Restore the partial epoch's metric history a preemption
+        checkpoint carried (``inflight``), so the epoch summary of the
+        resumed run reduces over exactly the same value stream an
+        uninterrupted run would have — the per-epoch statistics half of
+        the kill/resume bit-equivalence guarantee. Preemption bookkeeping
+        keys are popped either way so they never leak into later epoch
+        checkpoints or the CSV."""
+        inflight = self.state.pop("inflight", None)
+        self.state.pop("emergency_reason", None)
+        self.state.pop("preempt_signal", None)
+        if (
+            inflight
+            and int(self.state["current_iter"])
+            % self.cfg.total_iter_per_epoch != 0
+        ):
+            self.total_losses = self._restore_total_losses(
+                inflight.get("total_losses", {})
+            )
+
+    def _serialize_total_losses(self) -> Dict[str, List[Dict]]:
+        """The in-epoch metric history as (dtype-tagged) JSON: float32
+        device scalars, (k,)-stacked chunk arrays and host floats all
+        round-trip exactly (every float32/float64 is exactly representable
+        in JSON's shortest-roundtrip encoding), so the restored stream is
+        bit-identical to the one the preempted run accumulated. The
+        np.asarray here is a device->host sync — we are stopping anyway."""
+        out: Dict[str, List[Dict]] = {}
+        for key, vals in self.total_losses.items():
+            out[key] = [
+                {"dtype": str(np.asarray(v).dtype),
+                 "value": np.asarray(v).tolist()}
+                for v in vals
+            ]
+        return out
+
+    @staticmethod
+    def _restore_total_losses(serialized) -> Dict[str, List]:
+        out: Dict[str, List] = {}
+        for key, entries in serialized.items():
+            vals = []
+            for e in entries:
+                try:
+                    dt = np.dtype(e["dtype"])
+                except TypeError:
+                    dt = np.float64  # dtype from a newer build: values win
+                vals.append(np.array(e["value"], dtype=dt))
+            out[key] = vals
+        return out
+
+    def _truncate_stats_to_resume_point(self) -> None:
+        """Rewrite ``summary_statistics.csv`` keeping only rows with
+        ``epoch <= epochs covered by the resumed checkpoint``. The CSV row
+        for an epoch lands before that epoch's async checkpoint finalizes,
+        so a kill in between leaves a row from the dead run's future; the
+        resumed run re-trains that epoch and the final CSV must read as if
+        the kill never happened (the kill/resume equivalence tests compare
+        it row-for-row against an uninterrupted run). Atomic tmp+replace,
+        line-based so surviving rows keep their exact bytes."""
+        if not self.is_primary:
+            return
+        path = os.path.join(self.logs_filepath, "summary_statistics.csv")
+        if not os.path.isfile(path):
+            return
+        epochs_done = (
+            int(self.state["current_iter"]) // self.cfg.total_iter_per_epoch
+        )
+        with open(path) as f:
+            lines = f.readlines()
+        if not lines:
+            return
+        header = lines[0].rstrip("\n").split(",")
+        try:
+            epoch_col = header.index("epoch")
+        except ValueError:
+            return
+        kept = [lines[0]]
+        dropped = 0
+        for line in lines[1:]:
+            fields = line.rstrip("\n").split(",")
+            try:
+                row_epoch = int(float(fields[epoch_col]))
+            except (IndexError, ValueError):
+                dropped += 1  # malformed (torn write at the kill): drop too
+                continue
+            if row_epoch <= epochs_done:
+                kept.append(line)
+            else:
+                dropped += 1
+        if not dropped:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, path)
+        self._log(
+            f"[resilience] dropped {dropped} summary CSV row(s) beyond "
+            f"the resumed checkpoint (epoch > {epochs_done})"
+        )
+
+    def _on_retry(self, site: str, attempt: int, max_attempts: int,
+                  error: str, backoff_s: float) -> None:
+        """RetryPolicy observer: one loud stderr line + a telemetry
+        ``retry`` record + a flight-recorder note per failed attempt, so a
+        run that limped through transient I/O faults documents it."""
+        print(
+            f"[resilience] {site} attempt {attempt}/{max_attempts} failed "
+            f"({error}); retrying in {backoff_s:.2f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        # the first retryable seam (resume restore) runs before telemetry
+        # exists — stderr carries those
+        telemetry = getattr(self, "telemetry", None)
+        if telemetry is not None:
+            telemetry.event(
+                "retry",
+                site=site,
+                attempt=int(attempt),
+                max_attempts=int(max_attempts),
+                error=error,
+                backoff_s=float(backoff_s),
+            )
+        recorder = getattr(self, "flight_recorder", None)
+        if recorder is not None:
+            recorder.note_event(
+                "retry", site=site, attempt=int(attempt), error=error,
+            )
+
+    def _write_stats(self, fn, site: str):
+        """Retry a NON-essential metrics write; on an exhausted budget skip
+        it with a warning instead of killing the run — the telemetry twin
+        of the row (and the checkpoint's experiment state) still carry the
+        epoch, and the stats/checkpoint register sanity check tolerates the
+        hole. Essential writes (checkpoints) go through ``self.retry``
+        directly so exhaustion halts the run cleanly."""
+        try:
+            return self.retry.call(fn, site=site)
+        except RetriesExhaustedError as e:
+            print(
+                f"[resilience] {site} write skipped after exhausted "
+                f"retries: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+            return None
+
+    def _prune_consumed_emergency(self) -> None:
+        """Best-effort hygiene after an epoch checkpoint lands: a
+        *preemption* emergency checkpoint whose iteration the run has now
+        passed is fully superseded by ``latest`` — drop it so operators
+        don't mistake a stale emergency for pending trouble. (The resume
+        preference compares iterations, so leaving it behind would be
+        harmless; a halt emergency is never touched.)"""
+        if not self.is_primary:
+            return
+        try:
+            emerg = peek_experiment_state(
+                self.saved_models_filepath, "train_model", "emergency"
+            )
+            if (
+                emerg is not None
+                and emerg.get("emergency_reason") == "preemption"
+                and int(emerg.get("current_iter", -1))
+                <= int(self.state["current_iter"])
+            ):
+                remove_checkpoint(
+                    self.saved_models_filepath, "train_model", "emergency"
+                )
+        except OSError:
+            pass  # hygiene only — never load-bearing
+
+    def _install_signal_handlers(self) -> Optional[Dict]:
+        """Install the graceful-preemption SIGTERM/SIGINT handlers for the
+        duration of ``run_experiment`` (restored by the caller). Returns the
+        previous handlers, or None when disabled / not on the main thread
+        (signal.signal is main-thread-only; a builder driven from a worker
+        thread keeps the process defaults)."""
+        if not self.cfg.handle_preemption_signals:
+            return None
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        previous = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            previous[sig] = signal.signal(sig, self._on_preempt_signal)
+        return previous
+
+    def _on_preempt_signal(self, signum, frame) -> None:
+        """Latches the preemption request; the train loop drains it at the
+        next dispatch boundary. A SECOND SIGINT raises KeyboardInterrupt —
+        the operator escape hatch when the drain itself is stuck. (A first
+        SIGINT after a scheduler SIGTERM only re-latches: one stray Ctrl-C
+        must not abort the drain mid-emergency-checkpoint.)"""
+        if (
+            self._preempt_signum == int(signal.SIGINT)
+            and signum == signal.SIGINT
+        ):
+            raise KeyboardInterrupt
+        self._preempt_signum = int(signum)
+        print(
+            f"[resilience] received signal {signum}: draining at the next "
+            "dispatch boundary (emergency checkpoint, then exit "
+            f"{PREEMPT_EXIT_CODE})",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def _preempt_exit(self) -> None:
+        """The preemption drain, at a train dispatch boundary: wait out the
+        in-flight async checkpoint, write a RESUMABLE
+        ``train_model_emergency`` checkpoint (tagged ``preemption`` and
+        carrying the partial epoch's metric history), emit the telemetry
+        ``preemption`` record + a forensic flight-recorder dump, and raise
+        ``PreemptedError`` (a SystemExit with the distinct preemption exit
+        code). ``continue_from_epoch='latest'`` on the restarted run picks
+        this checkpoint up and resumes at the exact iteration."""
+        from . import checkpoint as ckpt
+
+        signum = int(self._preempt_signum)
+        it = int(self.state["current_iter"])
+        self._close_pbar()
+        self._log(
+            f"[resilience] preemption drain at iter {it} "
+            f"(signal {signum})"
+        )
+        self._beat("preempt_drain")
+        ckpt.wait_for_pending()  # pending async epoch save lands first
+        exp_state = dict(self.state)
+        exp_state["emergency_reason"] = "preemption"
+        exp_state["preempt_signal"] = signum
+        if it % self.cfg.total_iter_per_epoch != 0 and self.total_losses:
+            exp_state["inflight"] = {
+                "total_losses": self._serialize_total_losses()
+            }
+        self._beat("emergency_checkpoint")
+        ckpt_path = self.retry.call(
+            lambda: self.model.save_model(
+                self.saved_models_filepath, "emergency", exp_state,
+            ),
+            site="ckpt_save",
+        )
+        ckpt.wait_for_pending()  # on disk before the exit, not after
+        self.telemetry.event(
+            "preemption", iter=it, signal=signum, checkpoint=ckpt_path,
+        )
+        if self.flight_recorder is not None:
+            self.flight_recorder.note_event(
+                "preemption", iter=it, signal=signum, checkpoint=ckpt_path,
+            )
+            try:
+                dump_dir = self.flight_recorder.dump(
+                    "preemption",
+                    it,
+                    details={"signal": signum, "checkpoint": ckpt_path},
+                    state_dump_fn=None,  # the emergency ckpt IS the state
+                    force=True,
+                )
+            except Exception as e:  # noqa: BLE001 - forensics are garnish;
+                # the preemption exit (with its checkpoint) must not become
+                # a disk-full crash
+                print(f"[resilience] preemption ring dump failed: {e!r}",
+                      file=sys.stderr, flush=True)
+                dump_dir = None
+            if dump_dir is not None:
+                self.telemetry.event(
+                    "incident", iter=it, reason="preemption", path=dump_dir,
+                )
+        raise PreemptedError(signum, it, ckpt_path)
 
     # -- telemetry plumbing ------------------------------------------------
 
@@ -500,6 +861,11 @@ class ExperimentBuilder:
         halt = self._pop_health(losses)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += 1
+        # fault-injection heartbeat: publishes the completed-iteration
+        # counter (iter=N conditions) and delivers pseudo-site `signal`
+        # faults — a handled SIGTERM lands in _on_preempt_signal and is
+        # drained at the loop's next boundary check
+        faults.tick(int(self.state["current_iter"]))
         # with the model's one-step-lag sync, tick intervals equal device
         # step time at steady state (one step in flight, host waits on k-1)
         self.step_timer.tick()
@@ -529,6 +895,7 @@ class ExperimentBuilder:
         # issue 2k tiny device programs per chunk (see run_train_iters)
         self._accumulate(losses, self.total_losses)
         self.state["current_iter"] += len(train_samples)
+        faults.tick(int(self.state["current_iter"]))  # see train_iteration
         self.step_timer.tick()
         self._steps_this_run += len(train_samples)
         if halt:
@@ -644,11 +1011,20 @@ class ExperimentBuilder:
         epoch_summary["epoch_run_time"] = time.time() - self.start_time
         if self.create_summary_csv:
             self._csv_keys = list(epoch_summary.keys())
+            created = True
             if self.is_primary:
-                save_statistics(
-                    self.logs_filepath, self._csv_keys, create=True
-                )
-            self.create_summary_csv = False
+                created = self._write_stats(
+                    lambda: save_statistics(
+                        self.logs_filepath, self._csv_keys, create=True
+                    ),
+                    site="stats_write",
+                ) is not None
+            # an exhausted header write keeps this True so the NEXT epoch
+            # re-attempts the header (create='w' truncates any partial
+            # file) and this epoch's row append below is skipped — clearing
+            # it unconditionally would let later successful appends build a
+            # headerless CSV that breaks resume's header read
+            self.create_summary_csv = not created
         if self._csv_keys is None:
             # resumed run: append in the on-disk header's column order — a
             # header written by older code (fewer metric columns) must not
@@ -668,10 +1044,17 @@ class ExperimentBuilder:
             f"{k}: {v:.4f}" for k, v in epoch_summary.items()
             if "loss" in k or "accuracy" in k
         ))
-        if self.is_primary:
-            save_statistics(
-                self.logs_filepath,
-                [epoch_summary.get(k, "") for k in self._csv_keys],
+        if self.is_primary and not self.create_summary_csv:
+            # non-essential: retried, then skipped on exhaustion (the epoch
+            # telemetry record and the checkpoint's experiment state still
+            # carry the numbers); also skipped while the header itself is
+            # still owed — a row must never land before its header
+            self._write_stats(
+                lambda: save_statistics(
+                    self.logs_filepath,
+                    [epoch_summary.get(k, "") for k in self._csv_keys],
+                ),
+                site="stats_write",
             )
         # structured twins of the CSV row: epoch scalars (+ TensorBoard
         # mirror), dispatch-timing stats, device memory vs the store
@@ -711,6 +1094,10 @@ class ExperimentBuilder:
     # -- the loop (experiment_builder.py:302-371) -------------------------
 
     def run_experiment(self):
+        # graceful preemption: SIGTERM/SIGINT latch a drain request for the
+        # duration of the run (previous handlers restored on every exit
+        # path, so nested/test-harness use never leaks a handler)
+        previous_handlers = self._install_signal_handlers()
         if self.watchdog is not None:
             self.watchdog.start()
         try:
@@ -725,6 +1112,9 @@ class ExperimentBuilder:
                 self._beat("checkpoint_barrier")
                 ckpt.wait_for_pending()
             finally:
+                if previous_handlers is not None:
+                    for sig, handler in previous_handlers.items():
+                        signal.signal(sig, handler)
                 # the trace only materialises at stop — don't lose it when
                 # the run ends/pauses/raises before profile_num_steps
                 # completes
@@ -836,10 +1226,25 @@ class ExperimentBuilder:
                     # (one device->host serialization; the disk write
                     # overlaps the next epoch's training, see checkpoint.py)
                     self._beat("checkpoint_save")
-                    ckpt_path = self.model.save_model(
-                        self.saved_models_filepath, int(self.epoch),
-                        self.state, also_latest=True,
+                    # surface a PREVIOUS epoch's async-finalize failure
+                    # BEFORE entering the retry: that write's host snapshot
+                    # is gone, so it is not retryable — inside the retry it
+                    # would be mis-attributed to THIS save, absorbed on the
+                    # next attempt, and the run would train on with the
+                    # previous checkpoint permanently missing
+                    wait_for_pending()
+                    # essential write: transient failures retried with
+                    # backoff; an exhausted budget halts the run cleanly
+                    # (RetriesExhaustedError) — training past a lost
+                    # checkpoint would silently widen the crash window
+                    ckpt_path = self.retry.call(
+                        lambda: self.model.save_model(
+                            self.saved_models_filepath, int(self.epoch),
+                            self.state, also_latest=True,
+                        ),
+                        site="ckpt_save",
                     )
+                    self._prune_consumed_emergency()
                     self.telemetry.event(
                         "checkpoint",
                         epoch=int(self.epoch),
@@ -856,11 +1261,15 @@ class ExperimentBuilder:
                     self._pbar_sums = {}
                     self.epochs_done_in_this_run += 1
                     if self.is_primary:
-                        save_to_json(
-                            os.path.join(
-                                self.logs_filepath, "summary_statistics.json"
+                        self._write_stats(
+                            lambda: save_to_json(
+                                os.path.join(
+                                    self.logs_filepath,
+                                    "summary_statistics.json",
+                                ),
+                                self.state["per_epoch_statistics"],
                             ),
-                            self.state["per_epoch_statistics"],
+                            site="json_write",
                         )
                     if self.epochs_done_in_this_run >= cfg.total_epochs_before_pause:
                         # controlled pause for preemptible clusters (:367-370)
@@ -872,6 +1281,12 @@ class ExperimentBuilder:
                         self._active_pbar = self._pbar(
                             cfg.total_iter_per_epoch, f"train epoch {self.epoch}"
                         )
+                if self._preempt_signum is not None:
+                    # drained AFTER the epoch-boundary block: a signal that
+                    # lands near a boundary lets the epoch finish its
+                    # stats/checkpoint bookkeeping first, so the resumed
+                    # run's history has no hole
+                    self._preempt_exit()
             if pending:
                 # safety net: the loader always ends at an epoch boundary,
                 # but a truncated stream must not drop trained-sample work
@@ -1011,13 +1426,19 @@ class ExperimentBuilder:
             "test_accuracy_std": accuracy_std,
         }
         if self.is_primary:
-            save_statistics(
-                self.logs_filepath, list(test_losses.keys()),
-                create=True, filename="test_summary.csv",
+            self._write_stats(
+                lambda: save_statistics(
+                    self.logs_filepath, list(test_losses.keys()),
+                    create=True, filename="test_summary.csv",
+                ),
+                site="stats_write",
             )
-            save_statistics(
-                self.logs_filepath, list(test_losses.values()),
-                filename="test_summary.csv",
+            self._write_stats(
+                lambda: save_statistics(
+                    self.logs_filepath, list(test_losses.values()),
+                    filename="test_summary.csv",
+                ),
+                site="stats_write",
             )
         self._log(str(test_losses))
         return test_losses

@@ -50,8 +50,55 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..core.maml import MetaState
+from ..resilience import faults
 
 _EXPERIMENT_STATE_FILE = "experiment_state.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory exists but cannot be restored (partial write
+    survived a crash, bit rot, a foreign directory under ``saved_models/``).
+
+    Replaces the opaque orbax traceback with the path that failed, the
+    underlying error, and the *surviving* sibling checkpoints the operator
+    can fall back to (``latest``, ``emergency``, the kept best-val epochs)
+    — the triage decision is in the exception, not in a shell session.
+    """
+
+    def __init__(self, path: str, cause: BaseException,
+                 fallbacks: List[str]):
+        self.path = path
+        self.fallbacks = list(fallbacks)
+        hint = (
+            "surviving checkpoints in the same directory: "
+            + ", ".join(self.fallbacks)
+            if self.fallbacks
+            else "no other checkpoints survive in that directory"
+        )
+        super().__init__(
+            f"checkpoint at {path} is corrupt or partially written "
+            f"({cause!r}); {hint}. Resume with continue_from_epoch="
+            "'latest' (or a surviving epoch index), or delete the corrupt "
+            "directory and restart from_scratch."
+        )
+
+
+def list_checkpoints(model_save_dir: str, model_name: str) -> List[str]:
+    """Finalized ``<model_name>_*`` checkpoint directories (suffixes only,
+    e.g. ``['3', '5', 'emergency', 'latest']``) — in-flight ``.tmp`` and
+    crash-leftover ``.old`` siblings excluded."""
+    try:
+        names = os.listdir(model_save_dir)
+    except OSError:
+        return []
+    prefix = model_name + "_"
+    return sorted(
+        name[len(prefix):]
+        for name in names
+        if name.startswith(prefix)
+        and not name.endswith((".tmp", ".old"))
+        and os.path.isdir(os.path.join(model_save_dir, name))
+    )
 
 # one in-flight async save at a time: (finalizer thread, paths it will
 # create/replace, error holder). Module-level because checkpoints are a
@@ -116,6 +163,7 @@ def save_checkpoint(
     """Write one checkpoint directory (ref: save_model,
     few_shot_learning_system.py:399-408)."""
     wait_for_pending()  # serialize with any in-flight async save
+    faults.fire("ckpt_save")  # injectable seam (resilience/faults.py)
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     tmp = path + ".tmp"
     multiprocess = jax.process_count() > 1
@@ -213,15 +261,31 @@ def save_checkpoint_async(
             "use the collective save_checkpoint"
         )
     wait_for_pending()  # one in-flight save: serialize with the previous one
+    faults.fire("ckpt_save")  # injectable seam (resilience/faults.py);
+    # fired HERE, in the caller's thread before any state is handed to
+    # orbax, so a retry wrapper can simply re-call this function
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     tmp = path + ".tmp"
     shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp)
+    # The host copy must be REAL before this function returns: on the CPU
+    # backend a jax.Array is a zero-copy view of the device buffer, so
+    # handing the raw pytree to the background writer and then letting the
+    # next train step DONATE those buffers lets XLA reuse the very memory
+    # the write is still reading — silently corrupt early-epoch
+    # checkpoints (the first save, paying orbax's one-time setup, reliably
+    # lost that race) or a use-after-free segfault. On accelerators
+    # np.array() IS the device->host serialization the contract promises;
+    # either way it stays exactly one copy per epoch.
+    host_state = jax.tree_util.tree_map(
+        lambda x: np.array(x) if isinstance(x, jax.Array) else x,
+        state._asdict(),
+    )
     ckptr = _get_async_checkpointer()
     # blocks only for the device->host copy; the disk write is backgrounded
     ckptr.save(
         os.path.join(tmp, "state"),
-        args=ocp.args.StandardSave(state._asdict()),
+        args=ocp.args.StandardSave(host_state),
     )
     with open(os.path.join(tmp, _EXPERIMENT_STATE_FILE), "w") as f:
         json.dump(experiment_state, f, cls=_NumpyEncoder)
@@ -235,6 +299,10 @@ def save_checkpoint_async(
     def _finalize():
         try:
             ckptr.wait_until_finished()
+            # injectable seam: a sigkill fault here dies mid-finalize with
+            # the write complete but the swap not yet done — the window the
+            # crash-safe tmp/.old rename dance exists for
+            faults.fire("ckpt_finalize")
             _swap_into_place(tmp, path)
             if clone_path is not None:
                 clone_tmp = clone_path + ".tmp"
@@ -265,6 +333,7 @@ def load_checkpoint(
         ``maml.init_state``) providing shapes/dtypes for orbax.
     """
     wait_for_pending()  # never read past an in-flight async save
+    faults.fire("ckpt_restore")  # injectable seam (resilience/faults.py)
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     _recover_interrupted_swap(path)
     abstract = jax.tree_util.tree_map(
@@ -273,11 +342,53 @@ def load_checkpoint(
         else x,
         target_state._asdict(),
     )
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(os.path.join(path, "state"), abstract)
-    with open(os.path.join(path, _EXPERIMENT_STATE_FILE)) as f:
-        experiment_state = json.load(f)
+    if not os.path.isdir(path):
+        # genuinely absent (callers normally gate on checkpoint_exists):
+        # stays a FileNotFoundError, not a corruption report
+        raise FileNotFoundError(
+            f"checkpoint directory {path} does not exist"
+        )
+    try:
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.join(path, "state"), abstract)
+        # orbax hands back numpy VIEWS over tensorstore-owned buffers
+        # (owndata=False, base=PyCapsule). Training then feeds them to the
+        # donating train step; tying XLA buffer lifetime to a foreign
+        # allocator's capsule is how resumed runs died with heap-corruption
+        # segfaults at random later points. Copy ONCE into numpy-owned
+        # memory here, while the restore context is alive.
+        restored = jax.tree_util.tree_map(
+            lambda x: np.array(x) if isinstance(x, np.ndarray) else x,
+            restored,
+        )
+        with open(os.path.join(path, _EXPERIMENT_STATE_FILE)) as f:
+            experiment_state = json.load(f)
+    except Exception as e:  # noqa: BLE001 - orbax surfaces partial writes
+        # as a zoo of ValueError/KeyError/FileNotFoundError/XlaRuntimeError;
+        # all of them mean the same operational thing here
+        fallbacks = [
+            s for s in list_checkpoints(model_save_dir, model_name)
+            if s != str(model_idx)
+        ]
+        raise CheckpointCorruptError(path, e, fallbacks) from e
     return MetaState(**restored), experiment_state
+
+
+def peek_experiment_state(
+    model_save_dir: str, model_name: str, model_idx
+) -> Optional[Dict[str, Any]]:
+    """The experiment-state dict of a checkpoint WITHOUT restoring the
+    array pytree (None when the checkpoint or its JSON is absent/corrupt).
+    The resume logic uses this to compare ``current_iter`` across the
+    ``latest`` and ``emergency`` candidates before paying a restore."""
+    path = _ckpt_dir(model_save_dir, model_name, model_idx)
+    wait_for_pending(touching=path)
+    _recover_interrupted_swap(path)
+    try:
+        with open(os.path.join(path, _EXPERIMENT_STATE_FILE)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def checkpoint_exists(model_save_dir: str, model_name: str, model_idx) -> bool:

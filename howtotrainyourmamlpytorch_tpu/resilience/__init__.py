@@ -1,0 +1,65 @@
+"""Resilience: fault injection, retrying I/O, preemption handling.
+
+The training loop's headline operational property is that *induced failure
+is a tested input*: every I/O seam the experiment layer crosses
+(checkpoint save/restore, summary CSV/JSON writes, the loader producer
+thread, the builder's dispatch loop) can be made to fail deterministically
+via a ``fault_spec`` string (:mod:`resilience.faults`), transient failures
+are absorbed by a deterministic retry/backoff policy
+(:mod:`resilience.retry`), and a SIGTERM/SIGINT preemption drains pending
+checkpoints, writes a resumable emergency checkpoint and exits with
+``PREEMPT_EXIT_CODE`` so the scheduler can restart the run at the exact
+iteration (the builder's preemption path + ``PreemptedError``).
+
+Everything here is host-side: with ``fault_spec`` unset the injector is
+``None`` and every seam is a single attribute check — the jitted device
+programs are untouched by construction (tested).
+"""
+
+from .faults import (  # noqa: F401
+    FAULT_ACTIONS,
+    FAULT_SITES,
+    Fault,
+    FaultInjector,
+    active_injector,
+    fire,
+    install,
+    parse_fault_spec,
+    tick,
+    uninstall,
+)
+from .retry import (  # noqa: F401
+    RetriesExhaustedError,
+    RetryPolicy,
+)
+
+#: exit code of a preemption-triggered graceful shutdown (EX_TEMPFAIL:
+#: "temporary failure, retry" — distinct from crash codes and from the
+#: 128+signum codes of an *unhandled* signal, so schedulers and the
+#: chaos tests can tell "preempted cleanly, resume me" from "died")
+PREEMPT_EXIT_CODE = 75
+
+
+class PreemptedError(SystemExit):
+    """Raised by the builder at the dispatch boundary after a SIGTERM/SIGINT
+    preemption has been drained to disk (emergency checkpoint written,
+    telemetry ``preemption`` record emitted).
+
+    A ``SystemExit`` subclass carrying ``PREEMPT_EXIT_CODE``: uncaught, the
+    process exits with the distinct preemption code (``except Exception``
+    blocks can't swallow it); tests catch it by name in-process.
+    """
+
+    def __init__(self, signum: int, iter_at_preempt: int,
+                 checkpoint_path: str):
+        super().__init__(PREEMPT_EXIT_CODE)
+        self.signum = int(signum)
+        self.iter_at_preempt = int(iter_at_preempt)
+        self.checkpoint_path = checkpoint_path
+
+    def __str__(self) -> str:  # SystemExit.__str__ would print just "75"
+        return (
+            f"preempted by signal {self.signum} at iter "
+            f"{self.iter_at_preempt}; resumable checkpoint: "
+            f"{self.checkpoint_path}"
+        )

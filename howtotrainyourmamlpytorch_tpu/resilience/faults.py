@@ -1,0 +1,282 @@
+"""Deterministic fault injection for the training loop's I/O seams.
+
+A ``fault_spec`` string names *where*, *what* and *when* to fail::
+
+    ckpt_save:oserror@iter=40,producer:raise@batch=10,signal:sigterm@iter=55
+
+Grammar (comma-separated entries)::
+
+    entry   := site ":" action "@" key "=" value ["x" repeat]
+    site    := ckpt_save | ckpt_finalize | ckpt_restore | stats_write
+             | json_write | producer | signal
+    action  := oserror | raise | sigterm | sigint | sigkill
+    key     := iter | call | batch          (batch is an alias of call)
+    repeat  := how many consecutive triggers fire (default 1)
+
+Sites are the named host-side seams the experiment layer crosses:
+
+* ``ckpt_save``     — checkpoint save initiation (sync + async paths);
+* ``ckpt_finalize`` — the async save's background finalizer, just before
+  the tmp -> final swap (kill here to test crash-safe swaps);
+* ``ckpt_restore``  — checkpoint load;
+* ``stats_write``   — a ``summary_statistics.csv`` row append;
+* ``json_write``    — the ``summary_statistics.json`` mirror write;
+* ``producer``      — the loader's background episode-producer thread,
+  once per produced batch (``batch=N`` = the N-th batch any producer of
+  the process builds, 1-based);
+* ``signal``        — evaluated at the builder's dispatch boundary
+  (``tick``), not at a seam call: delivers the named signal to the own
+  process, modelling a TPU-pod preemption notice (sigterm), an operator
+  interrupt (sigint) or a hard kill (sigkill).
+
+Conditions: ``call=N`` (``batch=N``) matches the N-th invocation of that
+seam, counted per site across the whole process — deterministic because
+every seam is driven by the deterministic train loop. ``iter=N`` matches
+once the builder has *completed* iteration N (the builder publishes its
+counter via :func:`tick` after each dispatch). ``xK`` makes the fault
+fire on K consecutive matches (e.g. ``ckpt_save:oserror@call=1x2`` fails
+the first two save attempts — below a 3-attempt retry budget the run
+must recover and complete).
+
+Actions ``oserror`` (an ``OSError`` — the *retryable* class the
+:mod:`resilience.retry` policy absorbs) and ``raise`` (a ``RuntimeError``
+— never retried, models a logic bug) raise at the seam; the signal
+actions ``os.kill`` the own pid.
+
+With no spec installed, every seam is ``if _active is None: return`` —
+one module-global attribute check, zero allocations; and since injection
+lives entirely in host code, the jitted device programs are bit-identical
+with and without a spec (tested in ``tests/test_faults.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+FAULT_SITES = (
+    "ckpt_save",
+    "ckpt_finalize",
+    "ckpt_restore",
+    "stats_write",
+    "json_write",
+    "producer",
+    "signal",
+)
+
+FAULT_ACTIONS = ("oserror", "raise", "sigterm", "sigint", "sigkill")
+
+_CONDITION_KEYS = ("iter", "call", "batch")
+
+_SIGNALS = {
+    "sigterm": _signal.SIGTERM,
+    "sigint": _signal.SIGINT,
+    "sigkill": _signal.SIGKILL,
+}
+
+
+class InjectedFaultError(OSError):
+    """The ``oserror`` action: an OSError subclass so the retry policy and
+    every ``except OSError`` seam treat it exactly like a real transient
+    I/O failure, while postmortems can still tell it was injected."""
+
+
+@dataclass
+class Fault:
+    site: str
+    action: str
+    cond_key: str  # 'iter' | 'call' ('batch' normalizes to 'call')
+    cond_value: int
+    repeat: int = 1
+    fired: int = field(default=0, compare=False)
+
+    def spec(self) -> str:
+        """The entry's canonical spec string (round-trips through parse)."""
+        key = "batch" if self.site == "producer" else self.cond_key
+        out = f"{self.site}:{self.action}@{key}={self.cond_value}"
+        if self.repeat != 1:
+            out += f"x{self.repeat}"
+        return out
+
+
+def parse_fault_spec(spec: str) -> List[Fault]:
+    """Parse a ``fault_spec`` string; raises ``ValueError`` naming the
+    offending entry on any grammar violation (config-time validation runs
+    this, so a typo'd spec fails the run before any training happens)."""
+    faults: List[Fault] = []
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        head, sep, cond = entry.partition("@")
+        site, sep2, action = head.partition(":")
+        if not sep or not sep2:
+            raise ValueError(
+                f"fault_spec entry {entry!r} must look like "
+                "'site:action@key=value[xN]'"
+            )
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: unknown site {site!r} "
+                f"(known: {', '.join(FAULT_SITES)})"
+            )
+        if action not in FAULT_ACTIONS:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: unknown action {action!r} "
+                f"(known: {', '.join(FAULT_ACTIONS)})"
+            )
+        if site == "signal":
+            if action not in _SIGNALS:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: site 'signal' takes a "
+                    "signal action (sigterm|sigint|sigkill)"
+                )
+        elif action in _SIGNALS and action != "sigkill":
+            # sigkill at a seam is legal (kill mid-finalize); delivering a
+            # *handled* signal from an arbitrary seam would race the
+            # handler against the seam's own control flow
+            raise ValueError(
+                f"fault_spec entry {entry!r}: {action} is only valid at "
+                "site 'signal' (the dispatch boundary)"
+            )
+        key, sep3, value = cond.partition("=")
+        repeat = 1
+        if "x" in value:
+            value, _, rep = value.partition("x")
+            try:
+                repeat = int(rep)
+            except ValueError:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: repeat count {rep!r} "
+                    "is not an integer"
+                ) from None
+        if not sep3 or key not in _CONDITION_KEYS:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: condition must be one of "
+                f"{'/'.join(_CONDITION_KEYS)}=N"
+            )
+        try:
+            cond_value = int(value)
+        except ValueError:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: condition value {value!r} "
+                "is not an integer"
+            ) from None
+        if cond_value < 0 or repeat < 1:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: condition value must be >= 0 "
+                "and repeat >= 1"
+            )
+        faults.append(Fault(
+            site=site,
+            action=action,
+            cond_key="call" if key == "batch" else key,
+            cond_value=cond_value,
+            repeat=repeat,
+        ))
+    return faults
+
+
+class FaultInjector:
+    """Holds the parsed faults plus the per-site call counters and the
+    builder-published iteration counter. All entry points are lock-guarded:
+    the loader producer fires from its own thread while the train loop
+    ticks."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self._calls: Dict[str, int] = {}
+        self._iter = -1
+        self._lock = threading.Lock()
+
+    # -- trigger evaluation -------------------------------------------------
+
+    def fire(self, site: str) -> None:
+        """One seam invocation: advance the site counter, trigger matching
+        faults (raise / signal). Called by the seams themselves."""
+        with self._lock:
+            self._calls[site] = self._calls.get(site, 0) + 1
+            n = self._calls[site]
+            due = [
+                f for f in self.faults
+                if f.site == site and f.fired < f.repeat and (
+                    (f.cond_key == "call"
+                     and f.cond_value <= n < f.cond_value + f.repeat)
+                    or (f.cond_key == "iter" and self._iter >= f.cond_value)
+                )
+            ]
+            for f in due:
+                f.fired += 1
+        for f in due:
+            self._execute(f)
+
+    def tick(self, current_iter: int) -> None:
+        """The builder's dispatch-boundary heartbeat: publish the completed
+        iteration count (``iter=N`` conditions compare against it) and
+        evaluate the pseudo-site ``signal`` faults."""
+        with self._lock:
+            self._iter = int(current_iter)
+            due = [
+                f for f in self.faults
+                if f.site == "signal" and f.fired < f.repeat
+                and f.cond_key == "iter" and self._iter >= f.cond_value
+            ]
+            for f in due:
+                f.fired += 1
+        for f in due:
+            self._execute(f)
+
+    def _execute(self, f: Fault) -> None:
+        if f.action == "oserror":
+            raise InjectedFaultError(
+                f"injected fault {f.spec()!r} (deterministic test fault, "
+                "not a real I/O failure)"
+            )
+        if f.action == "raise":
+            raise RuntimeError(f"injected fault {f.spec()!r}")
+        # signal actions: deliver to the own process. SIGKILL is never
+        # handled — the process dies here, which is the point.
+        os.kill(os.getpid(), _SIGNALS[f.action])
+
+
+# -- module-level seam API ----------------------------------------------------
+#
+# The seams (storage.py, checkpoint.py, loader.py, builder.py) call these
+# module functions so that with no spec installed the cost is one global
+# read. The injector is process-wide state, like the checkpoint barrier:
+# faults model process-level failures.
+
+_active: Optional[FaultInjector] = None
+
+
+def install(spec: str) -> Optional[FaultInjector]:
+    """Install the process-wide injector from a spec string ('' or
+    whitespace uninstalls). Returns the injector (None when empty)."""
+    global _active
+    faults = parse_fault_spec(spec or "")
+    _active = FaultInjector(faults) if faults else None
+    return _active
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active_injector() -> Optional[FaultInjector]:
+    return _active
+
+
+def fire(site: str) -> None:
+    """Seam hook: no-op (one global read) unless an injector is installed."""
+    if _active is not None:
+        _active.fire(site)
+
+
+def tick(current_iter: int) -> None:
+    """Builder dispatch-boundary hook (see ``FaultInjector.tick``)."""
+    if _active is not None:
+        _active.tick(current_iter)

@@ -1,0 +1,136 @@
+"""Deterministic retry/backoff for the experiment layer's I/O seams.
+
+A TPU-pod run crosses a networked filesystem at every checkpoint save,
+stats-CSV append and JSON mirror write; any of those can fail transiently
+(NFS hiccup, GCS 5xx surfaced as OSError, disk-pressure ENOSPC that a
+cleaner resolves seconds later). ``RetryPolicy`` absorbs such failures:
+
+* retries **OSError only** — the transient I/O class (and the class the
+  fault injector's ``oserror`` action raises). Logic errors
+  (``RuntimeError`` etc.) propagate immediately: retrying a bug is how
+  silent corruption happens;
+* exponential backoff with **no jitter**: ``backoff_s * factor**(attempt-1)``
+  capped at ``max_backoff_s``. Deterministic by design — the kill/resume
+  equivalence tests (and any log diff) must see the same sequence every
+  run; a fleet-thundering-herd concern would belong to the scheduler
+  restarting whole runs, not to these per-file writes;
+* an ``observer(site, attempt, max_attempts, error, backoff_s)`` hook per
+  failed attempt — the builder wires it to a telemetry ``retry`` record
+  plus a flight-recorder note, so a run that limped through N transient
+  faults says so in its own log;
+* after ``max_attempts`` failures raises ``RetriesExhaustedError`` (the
+  original exception chained). The *caller* decides essentialness: the
+  builder halts cleanly on an exhausted checkpoint save (data loss
+  otherwise) and degrades on an exhausted stats write (skip the row, warn,
+  keep training — the telemetry twin still has the epoch record).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+
+class RetriesExhaustedError(RuntimeError):
+    """All retry attempts for one I/O seam failed; ``site``, ``attempts``
+    and the last error ride on the exception (and ``__cause__`` chains it)."""
+
+    def __init__(self, site: str, attempts: int, last_error: BaseException):
+        super().__init__(
+            f"I/O seam {site!r} failed {attempts} attempt(s); "
+            f"last error: {last_error!r}"
+        )
+        self.site = site
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+class RetryPolicy:
+    """Bounded retry with deterministic exponential backoff (module doc).
+
+    ``sleep`` is injectable so tests assert the exact backoff sequence
+    without waiting it out; ``observer`` is the per-attempt telemetry hook.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        backoff_s: float = 0.5,
+        factor: float = 2.0,
+        max_backoff_s: float = 30.0,
+        sleep: Callable[[float], None] = time.sleep,
+        observer: Optional[Callable[..., None]] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if backoff_s < 0 or factor < 1.0 or max_backoff_s < 0:
+            raise ValueError(
+                "backoff_s/max_backoff_s must be >= 0 and factor >= 1, got "
+                f"backoff_s={backoff_s}, factor={factor}, "
+                f"max_backoff_s={max_backoff_s}"
+            )
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.factor = float(factor)
+        self.max_backoff_s = float(max_backoff_s)
+        self.sleep = sleep
+        self.observer = observer
+
+    @classmethod
+    def from_config(cls, cfg, **overrides: Any) -> "RetryPolicy":
+        kwargs = dict(
+            max_attempts=cfg.io_retry_attempts,
+            backoff_s=cfg.io_retry_backoff_s,
+            factor=cfg.io_retry_backoff_factor,
+        )
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def backoff_for(self, attempt: int) -> float:
+        """Seconds slept after failed attempt ``attempt`` (1-based)."""
+        return min(
+            self.backoff_s * self.factor ** (attempt - 1), self.max_backoff_s
+        )
+
+    def call(self, fn: Callable[[], Any], site: str) -> Any:
+        """Run ``fn`` under the policy; returns its value, raises
+        ``RetriesExhaustedError`` (cause chained) once the budget is spent.
+        Only ``OSError`` is retried — anything else propagates on attempt 1.
+        """
+        last: Optional[OSError] = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except OSError as e:
+                last = e
+                if attempt >= self.max_attempts:
+                    break
+                delay = self.backoff_for(attempt)
+                if self.observer is not None:
+                    try:
+                        self.observer(
+                            site=site,
+                            attempt=attempt,
+                            max_attempts=self.max_attempts,
+                            error=repr(e),
+                            backoff_s=delay,
+                        )
+                    except Exception:  # noqa: BLE001 - telemetry must never
+                        pass           # turn a recoverable fault fatal
+                if delay > 0:
+                    self.sleep(delay)
+        # the exhausted attempt is observed too, so the log's last `retry`
+        # record shows attempt == max_attempts (the CLI counts tell the
+        # whole story without cross-referencing the crash)
+        if self.observer is not None:
+            try:
+                self.observer(
+                    site=site,
+                    attempt=self.max_attempts,
+                    max_attempts=self.max_attempts,
+                    error=repr(last),
+                    backoff_s=0.0,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        raise RetriesExhaustedError(site, self.max_attempts, last) from last

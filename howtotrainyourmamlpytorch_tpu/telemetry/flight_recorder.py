@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 import time
 from collections import deque
@@ -144,14 +145,20 @@ class FlightRecorder:
         while os.path.exists(path):  # same iter+reason twice: never clobber
             path = f"{base}.{n}"
             n += 1
-        os.makedirs(path)
-        with open(os.path.join(path, RING_FILENAME), "w") as f:
+        # assembled under a tmp name and renamed into place once complete:
+        # a crash mid-dump (the PR 6 fault matrix kills runs at arbitrary
+        # points) must never leave a manifest-less partial incident dir
+        # that postmortem tooling mistakes for a real incident
+        tmp = path + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, RING_FILENAME), "w") as f:
             for entry in ring:
                 f.write(json.dumps(_jsonable(entry)) + "\n")
         state_error = None
         if dump_state:
             try:
-                state_dump_fn(path)
+                state_dump_fn(tmp)
             except Exception as e:  # noqa: BLE001 - see docstring
                 state_error = repr(e)
         manifest = {
@@ -163,6 +170,7 @@ class FlightRecorder:
             "state_error": state_error,
             "details": _jsonable(details or {}),
         }
-        with open(os.path.join(path, INCIDENT_MANIFEST), "w") as f:
+        with open(os.path.join(tmp, INCIDENT_MANIFEST), "w") as f:
             json.dump(manifest, f, indent=2)
+        os.rename(tmp, path)
         return path

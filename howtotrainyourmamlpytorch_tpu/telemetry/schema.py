@@ -32,7 +32,18 @@ Record kinds:
 * ``incident``       — the flight recorder dumped its ring (and, when
   legal, a full state checkpoint) to ``logs/incidents/<name>/`` — the
   record carries the reason and the on-disk path. Reason ``halt`` marks
-  the escalation dump written just before ``TrainingDivergedError``.
+  the escalation dump written just before ``TrainingDivergedError``,
+  reason ``preemption`` the forensic dump of a graceful preemption exit;
+* ``retry``          — one failed attempt at a retrying I/O seam
+  (resilience/retry.py): the seam (``site``), the attempt number vs the
+  budget, the error, and the deterministic backoff slept before the next
+  attempt. A run that limped through transient filesystem faults says so
+  in its own log; the exhausted final attempt is recorded too
+  (``backoff_s`` 0);
+* ``preemption``     — a SIGTERM/SIGINT preemption was drained at the
+  dispatch boundary: the iteration, the signal number, and the resumable
+  emergency checkpoint path the run exited behind (exit code
+  ``resilience.PREEMPT_EXIT_CODE``).
 
 Version history / migration notes:
 
@@ -49,6 +60,13 @@ Version history / migration notes:
   version are tolerated envelope-only (numeric ``ts``, non-empty string
   ``kind``): unknown kinds and unknown fields from future schemas must
   never make an old reader reject a log it can still mostly use.
+* **v3** — adds the ``retry`` and ``preemption`` record kinds (the
+  resilience subsystem: retrying I/O seams and graceful preemption
+  exits). Pure additions again: every v1/v2 record validates unchanged
+  and the v2 forward-compat rules carry over verbatim (pinned fixtures
+  ``tests/fixtures/telemetry_future_schema.jsonl`` — a newer-than-v3
+  writer — and ``tests/fixtures/telemetry_v2_schema.jsonl`` — a v2-era
+  log — cover both directions).
 """
 
 from __future__ import annotations
@@ -56,7 +74,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -76,6 +94,8 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "watchdog_stall": ("stage", "seconds_since_progress", "stacks"),
     "anomaly": ("iter", "reason", "value", "threshold"),
     "incident": ("iter", "reason", "path"),
+    "retry": ("site", "attempt", "max_attempts", "error"),
+    "preemption": ("iter", "signal", "checkpoint"),
 }
 
 

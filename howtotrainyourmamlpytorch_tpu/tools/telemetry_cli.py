@@ -53,7 +53,7 @@ from ..telemetry.schema import iter_records, validate_file
 #: metrics `diff` watches for the divergence epoch unless --metric is given
 DEFAULT_WATCH_METRICS = ("train_loss_mean", "val_accuracy_mean")
 
-ANOMALY_KINDS = ("anomaly", "incident", "watchdog_stall")
+ANOMALY_KINDS = ("anomaly", "incident", "watchdog_stall", "preemption")
 
 
 def _load(path: str) -> List[dict]:
@@ -171,6 +171,10 @@ def cmd_summary(args) -> int:
         "anomalies": counts.get("anomaly", 0),
         "incidents": counts.get("incident", 0),
         "watchdog_stalls": counts.get("watchdog_stall", 0),
+        # resilience (schema v3): how many transient I/O faults the run
+        # retried through, and whether it exited on a preemption drain
+        "retries": counts.get("retry", 0),
+        "preemptions": counts.get("preemption", 0),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -227,6 +231,11 @@ def cmd_summary(args) -> int:
     if not payload["clean_shutdown"]:
         health += "  [no run_end marker: crashed, killed, or still running]"
     lines.append(health)
+    if payload["retries"] or payload["preemptions"]:
+        lines.append(
+            f"  resilience: {payload['retries']} I/O retries, "
+            f"{payload['preemptions']} preemption exits"
+        )
     _emit(payload, args.json, lines)
     return 0
 
@@ -292,6 +301,11 @@ def cmd_anomalies(args) -> int:
             lines.append(
                 f"incident  iter {it:>8}  {r.get('reason')}"
                 f"  -> {r.get('path')}"
+            )
+        elif kind == "preemption":
+            lines.append(
+                f"preempt   iter {it:>8}  signal {r.get('signal')}"
+                f"  -> {r.get('checkpoint')}"
             )
         else:
             lines.append(

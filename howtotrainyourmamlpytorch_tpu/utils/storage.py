@@ -14,6 +14,8 @@ import json
 import os
 from typing import Dict, Iterable, List, Tuple
 
+from ..resilience import faults
+
 
 def build_experiment_folder(experiment_name: str, root: str = ".") -> Tuple[str, str, str]:
     """Create <root>/<name>/{saved_models,logs,visual_outputs} (ref :49-66)."""
@@ -33,6 +35,7 @@ def save_statistics(
     create: bool = False,
 ) -> str:
     """Append one row (header row when ``create``) to the stats CSV (ref :18-29)."""
+    faults.fire("stats_write")  # injectable seam (resilience/faults.py)
     summary_filename = os.path.join(log_dir, filename)
     mode = "w" if create else "a"
     with open(summary_filename, mode) as f:
@@ -71,6 +74,7 @@ def save_to_json(filename: str, dict_to_store: dict) -> None:
     broke resume. The tmp+replace swap means readers only ever see the old
     or the new complete file.
     """
+    faults.fire("json_write")  # injectable seam (resilience/faults.py)
     path = os.path.abspath(filename)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

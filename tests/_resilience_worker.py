@@ -1,0 +1,63 @@
+"""Subprocess worker for the kill/resume crash-equivalence tests.
+
+Launched by ``tests/test_resilience_e2e.py`` with a ``fault_spec`` that
+kills the process mid-run (``signal:sigkill@iter=N`` at a dispatch
+boundary, or ``ckpt_finalize:sigkill@call=N`` inside the async checkpoint
+finalizer). Runs the SAME builder wiring the in-process tests use, against
+the same pre-built synthetic dataset, so a resumed run's outputs can be
+compared bit-for-bit with an uninterrupted in-process run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data_root", required=True)
+    ap.add_argument("--cache_dir", required=True)
+    ap.add_argument("--exp_root", required=True)
+    ap.add_argument("--exp_name", required=True)
+    ap.add_argument("--fault_spec", default="")
+    ap.add_argument("--total_epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # the test owns the config recipe: import it from the test module so
+    # the worker can never drift from the in-process runs it is compared to
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(tests_dir))  # repo root: the package
+    sys.path.insert(0, tests_dir)
+    from test_resilience_e2e import make_cfg
+
+    from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
+
+    cfg = make_cfg(
+        data_root=args.data_root,
+        cache_dir=args.cache_dir,
+        exp_root=args.exp_root,
+        exp_name=args.exp_name,
+        fault_spec=args.fault_spec,
+        total_epochs=args.total_epochs,
+    )
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    builder = ExperimentBuilder(
+        cfg, model, MetaLearningDataLoader,
+        experiment_root=args.exp_root, verbose=False,
+    )
+    builder.run_experiment()
+    print("WORKER_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

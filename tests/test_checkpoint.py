@@ -211,3 +211,59 @@ def test_kill_between_async_save_start_and_barrier_keeps_latest_loadable(
     assert exp["current_iter"] in (1, 2)
     expected = maml.init_state(cfg, seed=exp["current_iter"])
     assert _tree_equal(restored.net, expected.net)
+
+
+def test_async_save_snapshot_immune_to_donation_after_return(
+    tiny_cfg, tmp_path,
+):
+    """What lands on disk is the state AT save time, even though the caller
+    donates/mutates the buffers immediately after save_checkpoint_async
+    returns. On CPU a jax.Array is a zero-copy view of its buffer, so
+    without the eager host copy inside the async path, the donating next
+    step would mutate the very memory the background write was reading —
+    the silent early-epoch checkpoint corruption the kill/resume
+    equivalence suite caught (and the occasional use-after-free segfault)."""
+    cfg = tiny_cfg
+    state = maml.init_state(cfg, seed=3)
+    snapshot = jax.tree_util.tree_map(
+        lambda x: np.array(x), state._asdict()
+    )
+    ckpt.save_checkpoint_async(
+        str(tmp_path), "train_model", 1, state, {"current_iter": 1},
+        clone_to="latest",
+    )
+    # donate every buffer of the just-saved state straight back into a
+    # mutating jit BEFORE the background write barriers — repeatedly, so
+    # the old buffers are both invalidated and rewritten with new values
+    mutate = jax.jit(
+        lambda t: jax.tree_util.tree_map(lambda a: a * -3.0 + 1.0, t),
+        donate_argnums=(0,),
+    )
+    t = state._asdict()
+    for _ in range(4):
+        t = mutate(t)
+    jax.block_until_ready(t)
+    ckpt.wait_for_pending()
+    for idx in (1, "latest"):
+        restored, exp = ckpt.load_checkpoint(
+            str(tmp_path), "train_model", idx, maml.init_state(cfg)
+        )
+        assert _tree_equal(restored._asdict(), snapshot)
+        assert exp["current_iter"] == 1
+
+
+def test_restored_arrays_own_their_memory(tiny_cfg, tmp_path):
+    """Restored leaves must be numpy arrays owning their data — orbax hands
+    back views over tensorstore capsules, and feeding those into donating
+    train steps tied XLA buffer lifetime to a foreign allocator."""
+    cfg = tiny_cfg
+    state = maml.init_state(cfg, seed=4)
+    ckpt.save_checkpoint(
+        str(tmp_path), "train_model", 1, state, {"current_iter": 1}
+    )
+    restored, _ = ckpt.load_checkpoint(
+        str(tmp_path), "train_model", 1, maml.init_state(cfg)
+    )
+    for leaf in jax.tree_util.tree_leaves(restored._asdict()):
+        if isinstance(leaf, np.ndarray):
+            assert leaf.flags.owndata, "restored leaf is a borrowed view"

@@ -1,0 +1,239 @@
+"""Fault-injection registry (resilience/faults.py): grammar, deterministic
+triggers, seam wiring, and the no-spec zero-impact guarantee (identical
+jitted programs, bit-identical step metrics)."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.resilience import faults
+from howtotrainyourmamlpytorch_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedFaultError,
+    parse_fault_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# -- grammar ------------------------------------------------------------------
+
+
+def test_parse_issue_example_spec():
+    fs = parse_fault_spec(
+        "ckpt_save:oserror@iter=40,producer:raise@batch=10,"
+        "signal:sigterm@iter=55"
+    )
+    assert [(f.site, f.action, f.cond_key, f.cond_value, f.repeat)
+            for f in fs] == [
+        ("ckpt_save", "oserror", "iter", 40, 1),
+        ("producer", "raise", "call", 10, 1),  # batch normalizes to call
+        ("signal", "sigterm", "iter", 55, 1),
+    ]
+
+
+def test_parse_repeat_suffix_and_roundtrip():
+    (f,) = parse_fault_spec("ckpt_save:oserror@call=3x2")
+    assert (f.cond_value, f.repeat) == (3, 2)
+    assert parse_fault_spec(f.spec()) == [f]
+
+
+def test_parse_empty_and_whitespace_spec_is_no_faults():
+    assert parse_fault_spec("") == []
+    assert parse_fault_spec("  , ,") == []
+    assert faults.install("") is None
+    assert faults.active_injector() is None
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense",
+    "ckpt_save:oserror",              # no condition
+    "unknown_site:oserror@call=1",
+    "ckpt_save:unknown_action@call=1",
+    "ckpt_save:oserror@weird=1",      # unknown condition key
+    "ckpt_save:oserror@call=abc",
+    "ckpt_save:oserror@call=1x0",     # repeat must be >= 1
+    "signal:oserror@iter=5",          # signal site takes signal actions
+    "ckpt_save:sigterm@call=1",       # handled signals only at site signal
+])
+def test_parse_rejects_bad_entries(bad):
+    with pytest.raises(ValueError, match="fault_spec"):
+        parse_fault_spec(bad)
+
+
+def test_config_validates_fault_spec():
+    cfg = MAMLConfig(fault_spec="ckpt_save:oserror@call=1")
+    assert cfg.fault_spec == "ckpt_save:oserror@call=1"
+    with pytest.raises(ValueError, match="fault_spec"):
+        MAMLConfig(fault_spec="ckpt_save:oserror@")
+
+
+# -- trigger determinism ------------------------------------------------------
+
+
+def test_call_condition_fires_exact_window():
+    inj = FaultInjector(parse_fault_spec("stats_write:oserror@call=2x2"))
+    inj.fire("stats_write")  # call 1: clean
+    for _ in range(2):       # calls 2 and 3: the repeat window
+        with pytest.raises(InjectedFaultError):
+            inj.fire("stats_write")
+    inj.fire("stats_write")  # call 4: spent
+    inj.fire("json_write")   # other sites never affected
+
+
+def test_iter_condition_waits_for_builder_tick():
+    inj = FaultInjector(parse_fault_spec("ckpt_save:oserror@iter=40"))
+    inj.fire("ckpt_save")  # iter not yet reached: clean
+    inj.tick(39)
+    inj.fire("ckpt_save")
+    inj.tick(40)
+    with pytest.raises(InjectedFaultError):
+        inj.fire("ckpt_save")
+    inj.fire("ckpt_save")  # repeat=1: spent after one firing
+
+
+def test_raise_action_is_not_an_oserror():
+    inj = FaultInjector(parse_fault_spec("producer:raise@call=1"))
+    with pytest.raises(RuntimeError) as ei:
+        inj.fire("producer")
+    assert not isinstance(ei.value, OSError)  # never absorbed by retries
+
+
+def test_signal_site_delivers_on_tick():
+    seen = []
+    previous = signal.signal(
+        signal.SIGTERM, lambda s, f: seen.append(s)
+    )
+    try:
+        inj = FaultInjector(parse_fault_spec("signal:sigterm@iter=55"))
+        inj.tick(54)
+        assert seen == []
+        inj.tick(55)
+        assert seen == [signal.SIGTERM]
+        inj.tick(56)  # repeat=1: delivered exactly once
+        assert seen == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_injected_oserror_names_itself():
+    inj = FaultInjector(parse_fault_spec("json_write:oserror@call=1"))
+    with pytest.raises(InjectedFaultError, match="injected fault"):
+        inj.fire("json_write")
+
+
+# -- module seam API ----------------------------------------------------------
+
+
+def test_module_fire_noop_without_injector():
+    faults.uninstall()
+    faults.fire("ckpt_save")  # must not raise
+    faults.tick(10**9)
+
+
+def test_storage_seams_fire(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.storage import (
+        save_statistics,
+        save_to_json,
+    )
+
+    faults.install("stats_write:oserror@call=1,json_write:oserror@call=1")
+    with pytest.raises(InjectedFaultError):
+        save_statistics(str(tmp_path), ["a", "b"], create=True)
+    with pytest.raises(InjectedFaultError):
+        save_to_json(str(tmp_path / "x.json"), {"a": 1})
+    # both faults spent: the seams work again (retry semantics rely on it)
+    save_statistics(str(tmp_path), ["a", "b"], create=True)
+    save_to_json(str(tmp_path / "x.json"), {"a": 1})
+    assert (tmp_path / "x.json").exists()
+
+
+# -- zero impact without a spec ----------------------------------------------
+
+
+def test_jitted_train_program_identical_with_and_without_spec(tiny_cfg):
+    """The acceptance bar: fault injection lives entirely in host code, so
+    the lowered train-step program is byte-identical whether or not an
+    (untriggered) injector is installed."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+
+    cfg = tiny_cfg
+    state = maml.init_state(cfg)
+    b, n = 2, cfg.num_classes_per_set
+    s, t = cfg.num_samples_per_class, cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    args = (
+        state,
+        np.zeros((b, n, s, h, w, c), np.float32),
+        np.zeros((b, n, s), np.int32),
+        np.zeros((b, n, t, h, w, c), np.float32),
+        np.zeros((b, n, t), np.int32),
+        np.ones((cfg.number_of_training_steps_per_iter,), np.float32),
+        0.001,
+    )
+
+    def lowered_text():
+        return jax.jit(
+            maml.make_train_step(cfg, second_order=False)
+        ).lower(*args).as_text()
+
+    faults.uninstall()
+    without = lowered_text()
+    faults.install("ckpt_save:oserror@iter=40,signal:sigterm@iter=55")
+    with_spec = lowered_text()
+    assert without == with_spec
+
+
+def test_step_metrics_bit_identical_with_untriggered_spec(
+    tiny_cfg, synthetic_batch
+):
+    """Running real train steps with a never-triggering spec installed
+    produces bit-identical metrics and parameters."""
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    def run(spec):
+        faults.install(spec)
+        try:
+            model = MAMLFewShotClassifier(tiny_cfg, use_mesh=False)
+            out = []
+            for i in range(2):
+                batch = synthetic_batch(tiny_cfg, seed=i)
+                x_s, y_s, x_t, y_t = batch
+                losses = model.run_train_iter(
+                    (x_s, x_t, y_s, y_t), epoch=0
+                )
+                out.append(
+                    {k: np.asarray(v) for k, v in losses.items()}
+                )
+            import jax
+
+            params = jax.device_get(model.state.net)
+            return out, params
+        finally:
+            faults.uninstall()
+
+    out_a, params_a = run("")
+    out_b, params_b = run(
+        "ckpt_save:oserror@iter=999999,signal:sigterm@iter=999999"
+    )
+    for da, db in zip(out_a, out_b):
+        assert sorted(da) == sorted(db)
+        for k in da:
+            np.testing.assert_array_equal(da[k], db[k])
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(params_a),
+                    jax.tree_util.tree_leaves(params_b)):
+        np.testing.assert_array_equal(a, b)

@@ -327,12 +327,16 @@ def test_recorder_cooldown_and_state_dump_cap(tmp_path):
     rec = FlightRecorder(8, str(tmp_path / "inc"), max_state_dumps=1,
                          cooldown_steps=10)
     p1 = rec.dump("loss_spike", 0, state_dump_fn=dumps.append)
-    assert p1 is not None and dumps == [p1]
+    # the state dump is written into the STAGING dir (<path>.tmp) so the
+    # atomic publish rename covers it — a kill mid-dump never leaves a
+    # manifest-less partial incident dir
+    assert p1 is not None and dumps == [p1 + ".tmp"]
+    assert not os.path.exists(p1 + ".tmp")  # staging renamed away
     # inside the cooldown window: no dump at all
     assert rec.dump("loss_spike", 5, state_dump_fn=dumps.append) is None
     # window elapsed: incident written, but the state-dump cap is spent
     p2 = rec.dump("loss_spike", 10, state_dump_fn=dumps.append)
-    assert p2 is not None and dumps == [p1]
+    assert p2 is not None and dumps == [p1 + ".tmp"]
     with open(os.path.join(p2, INCIDENT_MANIFEST)) as f:
         assert json.load(f)["state_dumped"] is False
 

@@ -1,0 +1,402 @@
+"""Crash-equivalence proof: kill the run (SIGTERM gracefully, SIGKILL
+hard) at an arbitrary mid-epoch iteration, resume, and assert the final
+params and the per-epoch statistics are bit-identical to an uninterrupted
+run. Plus the transient-I/O-fault matrix: retried-through checkpoint
+faults with zero data loss, degraded stats writes, and the dead-producer
+fix — all on the CPU backend (the fast lane owns everything but the
+mid-finalize SIGKILL)."""
+
+import csv
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.data.loader import (
+    MetaLearningDataLoader,
+    ProducerCrashedError,
+)
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.experiment.system import MAMLFewShotClassifier
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    PREEMPT_EXIT_CODE,
+    PreemptedError,
+    faults,
+)
+
+TOTAL_ITER_PER_EPOCH = 4
+TOTAL_EPOCHS = 3
+PREEMPT_ITER = 6  # mid-epoch 2: partial-epoch state must survive resume
+
+
+def make_cfg(data_root, cache_dir, exp_root, exp_name, fault_spec="",
+             total_epochs=TOTAL_EPOCHS, **overrides):
+    """The one config recipe shared by the in-process runs AND the
+    subprocess worker (tests/_resilience_worker.py imports it), so every
+    compared run trains the identical program."""
+    kwargs = dict(
+        experiment_name=os.path.join(exp_root, exp_name),
+        dataset_name="imagenet_synthetic_presplit",
+        dataset_path=data_root,
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=8, image_width=8, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1, num_target_samples=1,
+        batch_size=2, cnn_num_filters=4, num_stages=1, max_pooling=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        second_order=False,
+        total_epochs=total_epochs,
+        total_iter_per_epoch=TOTAL_ITER_PER_EPOCH,
+        num_evaluation_tasks=4,
+        total_epochs_before_pause=100,
+        num_dataprovider_workers=2,
+        cache_dir=cache_dir,
+        use_mmap_cache=True, use_remat=False, seed=0,
+        telemetry_level="scalars",
+        io_retry_backoff_s=0.0,  # tests never sleep
+        # persistent compile cache DISABLED: on this jaxlib (0.4.37, CPU)
+        # a resumed run that executes the donating train step deserialized
+        # from the persistent cache flakily corrupts the CPU client
+        # (segfault mid-run in long-lived processes, or in the atexit
+        # clear_backends). Kill/resume tests resume constantly, so they
+        # pay the few-second CPU recompile instead ('' = off; the 'auto'
+        # default would re-enable it under the experiment dir).
+        compilation_cache_dir="",
+        fault_spec=fault_spec,
+    )
+    kwargs.update(overrides)
+    return MAMLConfig(**kwargs)
+
+
+def _write_presplit_rgb(root, n_classes=4, per_class=6, size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    for set_name in ("train", "val", "test"):
+        for ci in range(n_classes):
+            d = os.path.join(root, set_name, f"n{ci:04d}")
+            os.makedirs(d, exist_ok=True)
+            base = rng.randint(0, 200)
+            for j in range(per_class):
+                arr = np.clip(
+                    base + rng.randint(-30, 30, (size, size, 3)), 0, 255
+                ).astype(np.uint8)
+                Image.fromarray(arr, "RGB").save(os.path.join(d, f"im{j}.png"))
+
+
+class _Env:
+    """Shared dataset/cache/compile-cache plus the baseline run, built once
+    per module (every test compares against the same uninterrupted run)."""
+
+    def __init__(self, root):
+        self.root = str(root)
+        self.data_root = os.path.join(self.root, "imagenet_synthetic_presplit")
+        self.cache_dir = os.path.join(self.root, "cache")
+        _write_presplit_rgb(self.data_root)
+        self.baseline = self.run("baseline")
+
+    def cfg(self, exp_name, fault_spec="", **overrides):
+        return make_cfg(
+            self.data_root, self.cache_dir, self.root, exp_name,
+            fault_spec=fault_spec, **overrides,
+        )
+
+    def build(self, exp_name, fault_spec="", **overrides):
+        cfg = self.cfg(exp_name, fault_spec=fault_spec, **overrides)
+        model = MAMLFewShotClassifier(cfg, use_mesh=False)
+        return ExperimentBuilder(
+            cfg, model, MetaLearningDataLoader,
+            experiment_root=self.root, verbose=False,
+        )
+
+    def run(self, exp_name, fault_spec="", **overrides):
+        builder = self.build(exp_name, fault_spec=fault_spec, **overrides)
+        test_losses = builder.run_experiment()
+        return builder, test_losses
+
+    # -- comparison helpers -----------------------------------------------
+
+    def exp_dir(self, exp_name):
+        return os.path.join(self.root, exp_name)
+
+    def final_state(self, exp_name, epoch=TOTAL_EPOCHS):
+        from howtotrainyourmamlpytorch_tpu.core import maml
+
+        state, exp = ckpt.load_checkpoint(
+            os.path.join(self.exp_dir(exp_name), "saved_models"),
+            "train_model", epoch,
+            maml.init_state(self.cfg(exp_name + "_template")),
+        )
+        return state, exp
+
+    @staticmethod
+    def _deterministic_key(k):
+        """Training-math columns; timing/stream/wall-clock columns are
+        excluded — they can never be bit-stable across runs and are not
+        part of the equivalence contract."""
+        return (
+            "loss" in k or "accuracy" in k or "learning_rate" in k
+            or k == "epoch"
+        )
+
+    def det_rows(self, exp_name):
+        """The deterministic columns of the summary CSV."""
+        path = os.path.join(
+            self.exp_dir(exp_name), "logs", "summary_statistics.csv"
+        )
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        return [
+            {k: v for k, v in row.items() if self._deterministic_key(k)}
+            for row in rows
+        ]
+
+    def assert_equivalent(self, exp_name, epoch=TOTAL_EPOCHS):
+        """Bit-identical final params + experiment state + per-epoch
+        statistics vs the uninterrupted baseline."""
+        import jax
+
+        state_a, exp_a = self.final_state("baseline", epoch)
+        state_b, exp_b = self.final_state(exp_name, epoch)
+        for leaf_a, leaf_b in zip(
+            jax.tree_util.tree_leaves(state_a._asdict()),
+            jax.tree_util.tree_leaves(state_b._asdict()),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(leaf_a), np.asarray(leaf_b)
+            )
+        det = lambda stats: {  # noqa: E731
+            k: v for k, v in stats.items() if self._deterministic_key(k)
+        }
+        assert det(exp_a["per_epoch_statistics"]) == det(
+            exp_b["per_epoch_statistics"]
+        )
+        assert exp_a["current_iter"] == exp_b["current_iter"]
+        assert self.det_rows(exp_name) == self.det_rows("baseline")
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    faults.uninstall()
+    e = _Env(tmp_path_factory.mktemp("resilience"))
+    yield e
+    faults.uninstall()
+
+
+def _telemetry_records(env, exp_name):
+    path = os.path.join(env.exp_dir(exp_name), "logs", "telemetry.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- SIGTERM: graceful preemption + bit-exact resume --------------------------
+
+
+def test_sigterm_preempt_then_resume_is_bit_identical(env):
+    # the preemption run: a self-delivered SIGTERM at the iter-6 dispatch
+    # boundary (mid-epoch 2) must drain to a resumable emergency
+    # checkpoint and exit with the distinct code
+    builder = env.build("preempt", fault_spec=f"signal:sigterm@iter={PREEMPT_ITER}")
+    with pytest.raises(PreemptedError) as ei:
+        builder.run_experiment()
+    assert ei.value.code == PREEMPT_EXIT_CODE
+    assert ei.value.iter_at_preempt == PREEMPT_ITER
+
+    saved = os.path.join(env.exp_dir("preempt"), "saved_models")
+    emerg = ckpt.peek_experiment_state(saved, "train_model", "emergency")
+    assert emerg["emergency_reason"] == "preemption"
+    assert emerg["current_iter"] == PREEMPT_ITER
+    assert emerg["preempt_signal"] == signal.SIGTERM
+    # the partial epoch's metric history rides along for the resumed
+    # run's epoch summary
+    assert "loss" in emerg["inflight"]["total_losses"]
+
+    # preemption is documented in the run's own log: a schema-valid
+    # `preemption` record plus a forensic incident dir
+    from howtotrainyourmamlpytorch_tpu.telemetry import schema
+
+    log = os.path.join(env.exp_dir("preempt"), "logs", "telemetry.jsonl")
+    schema.validate_file(log)
+    records = _telemetry_records(env, "preempt")
+    (preempt_rec,) = [r for r in records if r["kind"] == "preemption"]
+    assert preempt_rec["iter"] == PREEMPT_ITER
+    assert preempt_rec["signal"] == signal.SIGTERM
+    incidents = [
+        r for r in records
+        if r["kind"] == "incident" and r["reason"] == "preemption"
+    ]
+    assert incidents and os.path.isdir(incidents[0]["path"])
+
+    # resume (no fault spec, like a scheduler restart): picks the
+    # emergency checkpoint over `latest` (iter 6 > 4) and completes
+    builder2, test_losses2 = env.run("preempt")
+    env.assert_equivalent("preempt")
+    assert test_losses2 == env.baseline[1]
+    # the consumed preemption emergency was pruned once epoch 2's
+    # checkpoint superseded it
+    assert not ckpt.checkpoint_exists(saved, "train_model", "emergency")
+
+
+def test_inspect_summary_surfaces_preemption_and_retry_counts(env, capsys):
+    from howtotrainyourmamlpytorch_tpu.tools import telemetry_cli
+
+    log = os.path.join(env.exp_dir("preempt"), "logs", "telemetry.jsonl")
+    assert telemetry_cli.main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["preemptions"] == 1
+    assert payload["counts_by_kind"]["preemption"] == 1
+    # human output names the resilience line too
+    assert telemetry_cli.main(["summary", log]) == 0
+    assert "preemption exits" in capsys.readouterr().out
+
+
+# -- SIGKILL: hard kill + resume from `latest` --------------------------------
+
+
+def _spawn_worker(env, exp_name, fault_spec, total_epochs=TOTAL_EPOCHS):
+    worker = os.path.join(os.path.dirname(__file__), "_resilience_worker.py")
+    return subprocess.run(
+        [sys.executable, worker,
+         "--data_root", env.data_root,
+         "--cache_dir", env.cache_dir,
+         "--exp_root", env.root,
+         "--exp_name", exp_name,
+         "--fault_spec", fault_spec,
+         "--total_epochs", str(total_epochs)],
+        capture_output=True, text=True, timeout=240,
+    )
+
+
+def test_sigkill_then_resume_is_bit_identical(env):
+    """SIGKILL at a mid-epoch dispatch boundary — no handler, no drain, the
+    process just dies. Resume from `latest` replays the partial epoch from
+    the last boundary checkpoint; the deterministic episode stream makes
+    the retrained run bit-identical to the uninterrupted baseline.
+
+    Killed at iter 10 (mid-epoch 3): the epoch-2 boundary save at iter 8
+    barriered the epoch-1 finalize before starting, so at the kill point a
+    loadable ``latest`` provably exists (epoch 1 or 2 — whichever the
+    still-async epoch-2 finalize reached; both resume equivalently)."""
+    kill_iter = 2 * TOTAL_ITER_PER_EPOCH + 2
+    proc = _spawn_worker(env, "hardkill", f"signal:sigkill@iter={kill_iter}")
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "WORKER_DONE" not in proc.stdout
+
+    saved = os.path.join(env.exp_dir("hardkill"), "saved_models")
+    # nothing graceful happened: no emergency checkpoint; `latest` is a
+    # boundary save (never the mid-epoch kill point)
+    assert not ckpt.checkpoint_exists(saved, "train_model", "emergency")
+    latest = ckpt.peek_experiment_state(saved, "train_model", "latest")
+    assert latest["current_iter"] in (
+        TOTAL_ITER_PER_EPOCH, 2 * TOTAL_ITER_PER_EPOCH,
+    )
+
+    builder2, test_losses2 = env.run("hardkill")
+    assert builder2.start_epoch in (1, 2)  # resumed from a boundary save
+    env.assert_equivalent("hardkill")
+    assert test_losses2 == env.baseline[1]
+
+
+@pytest.mark.slow
+def test_sigkill_mid_async_finalize_then_resume(env):
+    """PR 1's kill-mid-save crash-safety test, extended to the full builder
+    loop with the fault harness driving the kill point: SIGKILL inside the
+    async checkpoint finalizer thread (write done, tmp->final swap not).
+    Whatever instant the kill hit, a resumed run must find a loadable
+    state — `latest` or a clean from_scratch start — and end bit-identical
+    to the baseline."""
+    proc = _spawn_worker(env, "midfinalize", "ckpt_finalize:sigkill@call=1")
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+
+    saved = os.path.join(env.exp_dir("midfinalize"), "saved_models")
+    if ckpt.checkpoint_exists(saved, "train_model", "latest"):
+        latest = ckpt.peek_experiment_state(saved, "train_model", "latest")
+        assert latest["current_iter"] % TOTAL_ITER_PER_EPOCH == 0
+    builder2, test_losses2 = env.run("midfinalize")
+    env.assert_equivalent("midfinalize")
+    assert test_losses2 == env.baseline[1]
+
+
+# -- transient I/O faults below the retry budget ------------------------------
+
+
+def test_transient_ckpt_faults_below_budget_zero_data_loss(env):
+    """First two checkpoint-save attempts and the first JSON mirror write
+    fail with injected OSErrors; the 3-attempt budget absorbs them. The
+    run completes with `retry` telemetry records and outputs bit-identical
+    to the fault-free baseline — zero data loss."""
+    builder, test_losses = env.run(
+        "retryrun",
+        fault_spec="ckpt_save:oserror@call=1x2,json_write:oserror@call=1",
+    )
+    env.assert_equivalent("retryrun")
+    assert test_losses == env.baseline[1]
+    records = _telemetry_records(env, "retryrun")
+    retries = [r for r in records if r["kind"] == "retry"]
+    assert {r["site"] for r in retries} == {"ckpt_save", "json_write"}
+    assert len([r for r in retries if r["site"] == "ckpt_save"]) == 2
+    from howtotrainyourmamlpytorch_tpu.telemetry import schema
+
+    schema.validate_file(
+        os.path.join(env.exp_dir("retryrun"), "logs", "telemetry.jsonl")
+    )
+    # the JSON mirror exists despite its first write failing
+    assert os.path.isfile(os.path.join(
+        env.exp_dir("retryrun"), "logs", "summary_statistics.json"
+    ))
+
+
+def test_exhausted_stats_writes_degrade_without_killing_the_run(env):
+    """A permanently-broken stats CSV seam (every attempt fails) must not
+    kill training: rows are skipped with retry records, the epoch data
+    still lands in telemetry and the checkpoints."""
+    builder, test_losses = env.run(
+        "degraded",
+        fault_spec="stats_write:oserror@call=1x999",
+        io_retry_attempts=2,
+        total_epochs=1,
+    )
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+    logs = env.exp_dir("degraded") + "/logs"
+    assert not os.path.isfile(os.path.join(logs, "summary_statistics.csv"))
+    records = _telemetry_records(env, "degraded")
+    assert [r for r in records if r["kind"] == "retry"]
+    # the epoch numbers survived in the telemetry twin
+    assert [r for r in records if r["kind"] == "epoch"]
+
+
+# -- the dead-producer fix ----------------------------------------------------
+
+
+def test_producer_crash_fails_fast_and_poisons_later_pulls(env):
+    """A producer thread that dies must surface its exception to the train
+    loop (not hang until the watchdog) and re-raise from the next
+    get_*_batches pull."""
+    builder = env.build(
+        "producer_crash", fault_spec="producer:raise@batch=1"
+    )
+    with pytest.raises(ProducerCrashedError, match="injected fault"):
+        builder.run_experiment()
+    # the loader is poisoned: the NEXT pull re-raises instead of blocking
+    with pytest.raises(ProducerCrashedError):
+        builder.data.get_val_batches(total_batches=1)
+    with pytest.raises(ProducerCrashedError):
+        builder.data.get_train_batches(total_batches=1)
+
+
+def test_latched_producer_error_raises_from_next_pull(env):
+    """The latch half of the fix, without a thread death: a latched error
+    surfaces from the next pull even when no queue item ever carried it."""
+    loader = MetaLearningDataLoader(
+        env.cfg("latch_probe"), current_iter=0, cache_dir=env.cache_dir
+    )
+    loader._producer_error = RuntimeError("producer died off-queue")
+    with pytest.raises(ProducerCrashedError, match="died off-queue"):
+        loader.get_train_batches(total_batches=1)

@@ -1,0 +1,242 @@
+"""Retry/backoff policy (resilience/retry.py) and the retrying checkpoint
+I/O seams, incl. the corrupt-checkpoint diagnosis (CheckpointCorruptError)."""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    RetriesExhaustedError,
+    RetryPolicy,
+    faults,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# -- the policy ---------------------------------------------------------------
+
+
+def test_backoff_sequence_is_deterministic_and_capped():
+    p = RetryPolicy(max_attempts=6, backoff_s=0.5, factor=2.0,
+                    max_backoff_s=3.0, sleep=lambda s: None)
+    assert [p.backoff_for(a) for a in range(1, 6)] == [
+        0.5, 1.0, 2.0, 3.0, 3.0  # capped, no jitter
+    ]
+
+
+def test_retries_oserror_until_success_and_observes_each_attempt():
+    slept, observed = [], []
+    p = RetryPolicy(max_attempts=4, backoff_s=0.5, factor=2.0,
+                    sleep=slept.append, observer=lambda **kw: observed.append(kw))
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise OSError(f"transient {calls[0]}")
+        return "ok"
+
+    assert p.call(flaky, site="ckpt_save") == "ok"
+    assert calls[0] == 3
+    assert slept == [0.5, 1.0]  # deterministic exponential sequence
+    assert [(o["site"], o["attempt"], o["max_attempts"]) for o in observed] \
+        == [("ckpt_save", 1, 4), ("ckpt_save", 2, 4)]
+    assert all(o["backoff_s"] > 0 for o in observed)
+
+
+def test_exhausted_budget_raises_with_cause_and_final_observation():
+    observed = []
+    p = RetryPolicy(max_attempts=2, backoff_s=0.0,
+                    observer=lambda **kw: observed.append(kw))
+
+    def always():
+        raise OSError("still down")
+
+    with pytest.raises(RetriesExhaustedError) as ei:
+        p.call(always, site="stats_write")
+    assert ei.value.site == "stats_write"
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.__cause__, OSError)
+    # the exhausted final attempt is observed too (the log tells the
+    # whole story): attempts 1 and 2, the last with zero backoff
+    assert [o["attempt"] for o in observed] == [1, 2]
+    assert observed[-1]["backoff_s"] == 0.0
+
+
+def test_non_oserror_is_never_retried():
+    calls = [0]
+    p = RetryPolicy(max_attempts=5, backoff_s=0.0)
+
+    def bug():
+        calls[0] += 1
+        raise RuntimeError("logic bug")
+
+    with pytest.raises(RuntimeError, match="logic bug"):
+        p.call(bug, site="ckpt_save")
+    assert calls[0] == 1
+
+
+def test_from_config_and_validation():
+    cfg = MAMLConfig(io_retry_attempts=5, io_retry_backoff_s=0.25,
+                     io_retry_backoff_factor=3.0)
+    p = RetryPolicy.from_config(cfg, sleep=lambda s: None)
+    assert (p.max_attempts, p.backoff_s, p.factor) == (5, 0.25, 3.0)
+    with pytest.raises(ValueError, match="io_retry_attempts"):
+        MAMLConfig(io_retry_attempts=0)
+    with pytest.raises(ValueError, match="io_retry_backoff_factor"):
+        MAMLConfig(io_retry_backoff_factor=0.5)
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+
+
+def test_observer_failure_never_masks_the_seam():
+    def broken_observer(**kw):
+        raise ValueError("observer bug")
+
+    p = RetryPolicy(max_attempts=2, backoff_s=0.0, observer=broken_observer)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert p.call(flaky, site="x") == "ok"
+
+
+# -- checkpoint seam integration ---------------------------------------------
+
+
+def test_checkpoint_save_recovers_below_retry_budget(tiny_cfg, tmp_path):
+    """Injected OSErrors on the first two save attempts, 3-attempt budget:
+    the retried save succeeds and the checkpoint round-trips."""
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    state = maml.init_state(tiny_cfg, seed=1)
+    faults.install("ckpt_save:oserror@call=1x2")
+    p = RetryPolicy(max_attempts=3, backoff_s=0.0)
+    path = p.call(
+        lambda: ckpt.save_checkpoint_async(
+            str(tmp_path), "train_model", 1, state, {"current_iter": 4}
+        ),
+        site="ckpt_save",
+    )
+    ckpt.wait_for_pending()
+    assert os.path.isdir(path)
+    restored, exp = ckpt.load_checkpoint(
+        str(tmp_path), "train_model", 1, maml.init_state(tiny_cfg)
+    )
+    assert exp == {"current_iter": 4}
+
+
+def test_checkpoint_restore_fault_is_retryable(tiny_cfg, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    state = maml.init_state(tiny_cfg, seed=1)
+    ckpt.save_checkpoint_async(
+        str(tmp_path), "train_model", 1, state, {"current_iter": 4}
+    )
+    ckpt.wait_for_pending()
+    faults.install("ckpt_restore:oserror@call=1")
+    p = RetryPolicy(max_attempts=2, backoff_s=0.0)
+    restored, exp = p.call(
+        lambda: ckpt.load_checkpoint(
+            str(tmp_path), "train_model", 1, maml.init_state(tiny_cfg)
+        ),
+        site="ckpt_restore",
+    )
+    assert exp["current_iter"] == 4
+
+
+# -- corrupt checkpoints (satellite) -----------------------------------------
+
+
+def _save_epochs(cfg, tmp_path, idxs):
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    state = maml.init_state(cfg, seed=1)
+    for idx in idxs:
+        ckpt.save_checkpoint_async(
+            str(tmp_path), "train_model", idx, state, {"current_iter": 1}
+        )
+    ckpt.wait_for_pending()
+    return state
+
+
+def test_corrupt_checkpoint_raises_named_error_with_fallbacks(
+    tiny_cfg, tmp_path
+):
+    """A partially-written checkpoint directory must raise
+    CheckpointCorruptError naming the path and the surviving siblings —
+    not an opaque orbax traceback."""
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    _save_epochs(tiny_cfg, tmp_path, [2, 3, "latest"])
+    # simulate the partial write a crash leaves: the array payload is gone
+    shutil.rmtree(str(tmp_path / "train_model_2" / "state"))
+    with pytest.raises(ckpt.CheckpointCorruptError) as ei:
+        ckpt.load_checkpoint(
+            str(tmp_path), "train_model", 2, maml.init_state(tiny_cfg)
+        )
+    msg = str(ei.value)
+    assert str(tmp_path / "train_model_2") in msg
+    assert "3" in ei.value.fallbacks and "latest" in ei.value.fallbacks
+    assert "2" not in ei.value.fallbacks
+    # the named fallback still loads
+    ckpt.load_checkpoint(
+        str(tmp_path), "train_model", 3, maml.init_state(tiny_cfg)
+    )
+
+
+def test_truncated_experiment_state_is_reported_corrupt(tiny_cfg, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    _save_epochs(tiny_cfg, tmp_path, [1])
+    with open(tmp_path / "train_model_1" / "experiment_state.json", "w") as f:
+        f.write('{"current_iter": 4')  # crash mid-write
+    with pytest.raises(ckpt.CheckpointCorruptError, match="corrupt"):
+        ckpt.load_checkpoint(
+            str(tmp_path), "train_model", 1, maml.init_state(tiny_cfg)
+        )
+
+
+def test_missing_checkpoint_stays_file_not_found(tiny_cfg, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_checkpoint(
+            str(tmp_path), "train_model", 7, maml.init_state(tiny_cfg)
+        )
+
+
+def test_peek_experiment_state(tiny_cfg, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    _save_epochs(tiny_cfg, tmp_path, ["emergency"])
+    # enrich the JSON the way the preemption path does
+    p = tmp_path / "train_model_emergency" / "experiment_state.json"
+    state = json.loads(p.read_text())
+    state["emergency_reason"] = "preemption"
+    p.write_text(json.dumps(state))
+    peeked = ckpt.peek_experiment_state(
+        str(tmp_path), "train_model", "emergency"
+    )
+    assert peeked["emergency_reason"] == "preemption"
+    assert ckpt.peek_experiment_state(str(tmp_path), "train_model", 9) is None
+    assert ckpt.list_checkpoints(str(tmp_path), "train_model") == ["emergency"]

@@ -274,10 +274,12 @@ def _stub_builder(tmp_path, cfg):
     from types import SimpleNamespace
 
     from howtotrainyourmamlpytorch_tpu.experiment.builder import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.resilience import RetryPolicy
     from howtotrainyourmamlpytorch_tpu.utils.profiling import StepTimer
 
     stub = SimpleNamespace(
         cfg=cfg,
+        retry=RetryPolicy(max_attempts=1),
         logs_filepath=str(tmp_path),
         step_timer=StepTimer(),
         state={},
@@ -301,7 +303,7 @@ def _stub_builder(tmp_path, cfg):
         _log=lambda msg: None,
     )
     for name in ("pack_and_save_metrics", "_stream_metrics",
-                 "_flush_dynamics", "_existing_csv_header"):
+                 "_flush_dynamics", "_existing_csv_header", "_write_stats"):
         setattr(stub, name, getattr(ExperimentBuilder, name).__get__(stub))
     return stub
 
@@ -546,7 +548,7 @@ def test_validate_tolerates_newer_schema_versions():
 
 
 def test_validate_file_accepts_future_schema_fixture():
-    """The pinned mixed-version fixture: v1 records, an unknown v3 kind,
+    """The pinned mixed-version fixture: v1 records, an unknown v4 kind,
     and v99 records that dropped/renamed required fields all pass — the
     forward-compatibility contract, frozen as a file so a validator
     refactor can't silently tighten it."""
@@ -554,6 +556,29 @@ def test_validate_file_accepts_future_schema_fixture():
         os.path.dirname(__file__), "fixtures", "telemetry_future_schema.jsonl"
     )
     assert tel.validate_file(fixture) == 5
+
+
+def test_validate_file_accepts_v2_era_fixture():
+    """The pinned v2-era log (written before the v3 `retry`/`preemption`
+    kinds existed) validates unchanged under the v3 validator — the
+    backward half of the version contract: v3 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v2_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 6
+
+
+def test_v3_resilience_record_kinds_validate():
+    """The schema v3 additions: one record of each new kind, built through
+    the sink's make_record, passes strict validation."""
+    tel.validate_record(tel.make_record(
+        "retry", site="ckpt_save", attempt=1, max_attempts=3,
+        error="InjectedFaultError('x')", backoff_s=0.5,
+    ))
+    tel.validate_record(tel.make_record(
+        "preemption", iter=55, signal=15,
+        checkpoint="saved_models/train_model_emergency",
+    ))
 
 
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------
