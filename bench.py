@@ -505,91 +505,11 @@ _WORKLOAD_KNOBS = (
     "BENCH_PAD_CHANNELS",
 )
 
-# scalar cost_analysis keys surfaced whole; any OTHER key the backend
-# exposes (e.g. per-category entries on TPU builds) lands in the breakdown
-# dict — except the per-operand "bytes accessedN{}" / "utilizationN{}"
-# noise, which is filtered out entirely (it scales with operand count and
-# would bloat the bench line without naming an op class)
-_HLO_SCALAR_KEYS = ("flops", "transcendentals", "bytes accessed",
-                    "optimal_seconds")
-
-
-def _cost_analysis_dict(compiled) -> dict:
-    """``compiled.cost_analysis()`` normalized to one dict (older jax
-    returns ``[dict]``, newer a plain dict) — the single normalization
-    point for main() and the breakdown below."""
-    ca = compiled.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0] if ca else {}
-    return ca
-
-
-def _hlo_cost_breakdown(compiled, ca: dict) -> dict | None:
-    """Per-category HLO cost summary of the flagship step executable.
-
-    Combines XLA's cost analysis ``ca`` (total flops / bytes accessed, plus
-    any per-category entries the backend exposes) with an opcode census of
-    the optimized HLO (instruction counts per op class — dot vs convolution
-    vs fusion ...), so a lowering regression (e.g. the task-batched GEMM
-    conv silently falling back to grouped convolutions) is visible in the
-    BENCH_* trajectory without a profiler. Best-effort: returns None when
-    the backend exposes neither surface.
-    """
-    import re
-
-    out: dict = {}
-    try:
-        for key in _HLO_SCALAR_KEYS:
-            if key in ca:
-                out[key.replace(" ", "_")] = float(ca[key])
-        breakdown = {
-            k: float(v)
-            for k, v in ca.items()
-            if k not in _HLO_SCALAR_KEYS
-            and not re.fullmatch(r"(bytes accessed|utilization)\w*\{\}", k)
-        }
-        if breakdown:
-            out["cost_breakdown"] = breakdown
-    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
-        print(f"bench: cost_analysis breakdown unavailable ({e!r})",
-              file=sys.stderr)
-    try:
-        ops: dict = {}
-        for m in re.finditer(r"=\s+\S+\s+([a-z][a-z0-9-]*)\(",
-                             compiled.as_text()):
-            ops[m.group(1)] = ops.get(m.group(1), 0) + 1
-        # only the op classes that distinguish a GEMM-lowered step from a
-        # grouped-conv/relayout-heavy one — the full census would bloat the
-        # bench line with elementwise noise
-        interesting = (
-            "dot", "convolution", "fusion", "custom-call", "all-reduce",
-            "all-gather", "reduce-scatter", "copy", "transpose", "pad",
-            "gather", "scatter", "while",
-        )
-        census = {k: ops[k] for k in interesting if k in ops}
-        if census:
-            out["hlo_op_counts"] = census
-    except Exception as e:  # noqa: BLE001
-        print(f"bench: HLO op census unavailable ({e!r})", file=sys.stderr)
-    return out or None
-
-
-def _donation_stats(compiled, donate_argnums) -> dict | None:
-    """Aliasing/donation figures of the compiled step: a donation regression
-    (state no longer aliased in place -> double-buffered params+Adam in HBM)
-    shows up as alias_size_bytes collapsing toward zero."""
-    try:
-        ma = compiled.memory_analysis()
-        return {
-            "donate_argnums": list(donate_argnums),
-            "alias_size_bytes": int(ma.alias_size_in_bytes),
-            "argument_size_bytes": int(ma.argument_size_in_bytes),
-            "output_size_bytes": int(ma.output_size_in_bytes),
-            "temp_size_bytes": int(ma.temp_size_in_bytes),
-        }
-    except Exception as e:  # noqa: BLE001 - memory analysis is best-effort
-        print(f"bench: memory_analysis unavailable ({e!r})", file=sys.stderr)
-        return {"donate_argnums": list(donate_argnums)}
+# The hlo_cost / donation helpers (cost-analysis normalization, optimized-
+# HLO op census, aliasing stats) live in analysis/contracts.py — the SAME
+# census the program-contract auditor pins in CONTRACTS.json, so bench
+# lines and contract audits can never disagree about what the lowering
+# contains. Imported inside main() after the backend is settled.
 
 
 def main() -> None:
@@ -614,6 +534,11 @@ def main() -> None:
     timed_steps = int(os.environ.get("BENCH_TIMED_STEPS", 20))
     # deferred until the backend is settled: these imports initialize jax
     from __graft_entry__ import _flagship_cfg
+    from howtotrainyourmamlpytorch_tpu.analysis.contracts import (
+        cost_analysis_dict,
+        donation_stats,
+        hlo_cost_breakdown,
+    )
     from howtotrainyourmamlpytorch_tpu.core import maml, msl
     overrides = {}
     for key in ("batch_size", "cnn_num_filters", "image_height", "image_width",
@@ -696,10 +621,10 @@ def main() -> None:
         compiled = step.lower(
             state, x_s, y_s, x_t, y_t, weights, 1e-3
         ).compile()
-        ca = _cost_analysis_dict(compiled)
+        ca = cost_analysis_dict(compiled)
         xla_flops_per_batch = float(ca["flops"])
-        hlo_cost = _hlo_cost_breakdown(compiled, ca)
-        donation = _donation_stats(compiled, maml.TRAIN_DONATE)
+        hlo_cost = hlo_cost_breakdown(compiled, ca)
+        donation = donation_stats(compiled, maml.TRAIN_DONATE)
     except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
         print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
 

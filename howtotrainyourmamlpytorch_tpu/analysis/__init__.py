@@ -1,0 +1,22 @@
+"""Static analysis: program-contract audits + a repo-specific lint pass.
+
+Two layers with different import weights:
+
+* :mod:`analysis.contracts` (stdlib-only) — the contract vocabulary
+  (``ContractViolation`` / ``AuditError``), the optimized-HLO op-census
+  helpers shared with ``bench.py``, and the pinned ``CONTRACTS.json``
+  baseline format;
+* :mod:`analysis.auditor` (imports jax) — ``ProgramAuditor`` verifies the
+  contracts against the jaxpr and compiled HLO of every jitted program the
+  system builds, and ``RetraceDetector`` watches abstract dispatch
+  signatures at runtime for mid-run retraces;
+* :mod:`analysis.lint` (stdlib-only, AST-based) — repo-specific
+  traced-code pitfall checkers, runnable on a machine without jax.
+
+``cfg.analysis_level`` gates everything: ``'off'`` (default) installs
+nothing and the jitted programs are bit-identical to a pre-analysis build
+(tested); ``'warn'`` audits at program-build time and reports retraces to
+telemetry; ``'strict'`` fails the run on any violation or retrace.
+"""
+
+from .contracts import AuditError, ContractViolation  # noqa: F401

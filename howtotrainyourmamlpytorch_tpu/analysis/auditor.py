@@ -1,0 +1,483 @@
+"""ProgramAuditor: statically verify contracts on every jitted program.
+
+The repo's performance story rests on invariants asserted nowhere at
+runtime: whole-state donation, index-only H2D with zero mid-step
+transfers, GEMM lowering with no grouped convs on the hot path, bf16/f32
+dtype discipline, and zero mid-run retraces. The auditor turns them into
+machine-checked contracts: given any jitted callable the system builds, it
+traces (``jitted.trace``) and compiles (AOT — ``ShapeDtypeStruct`` args,
+so auditing allocates nothing) and verifies each contract against the
+jaxpr and the optimized HLO. See :mod:`analysis.contracts` for the
+contract list and the pinned ``CONTRACTS.json`` baseline format.
+
+Two entry points:
+
+* ``audit_system_programs(cfg)`` — the canonical program family: the four
+  train-step jits (plain / multi / indexed / multi-indexed, the same
+  factories ``experiment/system.py`` jits with ``maml.TRAIN_DONATE``),
+  the fused eval multi-step, and the device-pipeline index expander.
+  Driven by ``cli audit``, the builder's build-time audit
+  (``analysis_level != 'off'``) and the contract tests.
+* ``RetraceDetector`` — the runtime half: hashes the abstract signature
+  (treedef + leaf shapes/dtypes) of every dispatch at its site; a second
+  distinct signature at one site is a mid-run retrace (a new 20-40s TPU
+  compile nothing should be paying) — reported via ``on_retrace`` (the
+  builder emits a telemetry ``retrace`` record, schema v4) and fatal
+  under ``analysis_level='strict'``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MAMLConfig
+from ..core import maml
+from ..ops import device_pipeline
+from . import contracts as C
+
+#: jaxpr primitives that move data across the host<->device boundary (or
+#: call back into the host) — none may appear inside a step program
+TRANSFER_PRIMITIVES = frozenset({
+    "device_put", "infeed", "outfeed", "pure_callback", "io_callback",
+    "debug_callback", "callback", "host_callback_call", "copy_to_host",
+})
+
+#: f32-operand dot/conv ops with outputs at or below this element count are
+#: tolerated under the bf16 policy: scalar-loss reductions (the MSL
+#: weighting dot, cross-entropy means) legitimately run in f32 for
+#: stability; anything bigger is real matmul compute leaking off the
+#: bf16 MXU path (calibrated: the clean bf16 train step's largest f32 dot
+#: output is 8 elements, the smallest genuine-compute dot is >200)
+F32_MATMUL_OUTPUT_LIMIT = 64
+
+_MATMUL_PRIMITIVES = ("dot_general", "conv_general_dilated")
+
+
+def _iter_subjaxprs(params: Dict[str, Any]):
+    """Jaxprs nested in an eqn's params (pjit/scan/cond/remat/custom_*)."""
+    for value in params.values():
+        items = value if isinstance(value, (tuple, list)) else (value,)
+        for item in items:
+            if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                yield item.jaxpr  # ClosedJaxpr
+            elif hasattr(item, "eqns"):
+                yield item  # raw Jaxpr
+
+def walk_jaxpr(jaxpr, visit: Callable[[Any], None]) -> None:
+    """Depth-first visit of every eqn in ``jaxpr`` and its sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for sub in _iter_subjaxprs(eqn.params):
+            walk_jaxpr(sub, visit)
+
+
+def _eqn_avals(eqn):
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "dtype"):
+            yield aval
+
+
+def tree_byte_size(tree) -> int:
+    """Total bytes of a pytree of arrays / ShapeDtypeStructs."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            total += int(np.prod(leaf.shape, dtype=np.int64)) * int(
+                np.dtype(leaf.dtype).itemsize
+            )
+    return total
+
+
+class ProgramAuditor:
+    """Verify the program contracts on jitted callables.
+
+    ``baseline`` is a parsed ``CONTRACTS.json`` (or None: the op-census
+    regression check degrades to the invariant constraints only);
+    ``config_fingerprint`` must match the baseline's for the census
+    compare to arm (see ``contracts.baseline_comparable``).
+    """
+
+    def __init__(
+        self,
+        cfg: MAMLConfig,
+        baseline: Optional[dict] = None,
+        config_fingerprint: str = "",
+    ):
+        self.cfg = cfg
+        self.baseline = baseline
+        self._census_armed = C.baseline_comparable(
+            baseline,
+            jax_version=jax.__version__,
+            config_fingerprint=config_fingerprint,
+        )
+
+    # -- the audit ---------------------------------------------------------
+
+    def audit(
+        self,
+        program: str,
+        jitted,
+        args: Sequence[Any],
+        donate: Tuple[int, ...] = (),
+        expect_no_grouped_conv: Optional[bool] = None,
+    ) -> C.AuditReport:
+        """Trace + compile ``jitted(*args)`` and check every contract.
+
+        ``args`` may be ``ShapeDtypeStruct`` trees — the audit is fully
+        abstract and allocates nothing. ``donate`` declares which argnums
+        the *system* donates (the jit must have been built with matching
+        ``donate_argnums``; the donation contract checks the executable
+        actually honors it). ``expect_no_grouped_conv`` overrides the
+        config-derived arming of the grouped-conv census constraint
+        (tests use it to point the contract at a deliberately grouped
+        lowering).
+        """
+        violations: List[C.ContractViolation] = []
+
+        def flag(contract: str, detail: str) -> None:
+            violations.append(C.ContractViolation(contract, program, detail))
+
+        # any "donated buffers were not usable" diagnostic jax emits while
+        # tracing/compiling is a donation-contract failure in its own right
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            traced = jitted.trace(*args)
+            self._check_jaxpr(program, traced.jaxpr.jaxpr, flag)
+            compiled = traced.lower().compile()
+        for w in caught:
+            msg = str(w.message)
+            if "donated" in msg.lower():
+                flag("donation", f"compiler diagnostic: {msg}")
+
+        hlo_text = compiled.as_text()
+        census = C.interesting_census(hlo_text)
+        donation = None
+        if donate:
+            donation = C.donation_stats(compiled, donate)
+            state_bytes = sum(tree_byte_size(args[i]) for i in donate)
+            alias = donation.get("alias_size_bytes")
+            if alias is None:
+                flag("donation", "memory_analysis unavailable on this "
+                                 "backend; donation unverifiable")
+            elif alias < state_bytes:
+                flag(
+                    "donation",
+                    f"executable aliases {alias} bytes but the donated "
+                    f"argument(s) hold {state_bytes} bytes — the state is "
+                    "double-buffered (donate_argnums missing or unusable)",
+                )
+        self._check_hlo(program, hlo_text, census, flag,
+                        expect_no_grouped_conv)
+        return C.AuditReport(
+            program=program,
+            backend=jax.default_backend(),
+            contracts_checked=C.CONTRACT_NAMES,
+            violations=violations,
+            census=census,
+            donation=donation,
+        )
+
+    def _check_jaxpr(self, program: str, jaxpr, flag) -> None:
+        bf16 = self.cfg.compute_dtype == "bfloat16"
+        transfer_hits: Dict[str, int] = {}
+        f64_prims: Dict[str, int] = {}
+        f32_matmuls: List[str] = []
+
+        def visit(eqn):
+            name = eqn.primitive.name
+            if name in TRANSFER_PRIMITIVES:
+                transfer_hits[name] = transfer_hits.get(name, 0) + 1
+            for aval in _eqn_avals(eqn):
+                if str(aval.dtype) == "float64":
+                    f64_prims[name] = f64_prims.get(name, 0) + 1
+                    break
+            if bf16 and name in _MATMUL_PRIMITIVES:
+                in_dtypes = [
+                    str(v.aval.dtype)
+                    for v in eqn.invars
+                    if hasattr(getattr(v, "aval", None), "dtype")
+                ]
+                out = eqn.outvars[0].aval
+                out_size = int(np.prod(out.shape, dtype=np.int64)) if (
+                    out.shape
+                ) else 1
+                if "float32" in in_dtypes and (
+                    out_size > F32_MATMUL_OUTPUT_LIMIT
+                ):
+                    f32_matmuls.append(
+                        f"{name} with f32 operands -> {out.shape}"
+                    )
+
+        walk_jaxpr(jaxpr, visit)
+        if transfer_hits:
+            flag(
+                "no_transfer",
+                "host<->device primitives inside the program: "
+                + ", ".join(f"{k} x{v}" for k, v in sorted(
+                    transfer_hits.items())),
+            )
+        if f64_prims:
+            flag(
+                "dtype_policy",
+                "float64 values in the program (x64 creep): "
+                + ", ".join(f"{k} x{v}" for k, v in sorted(f64_prims.items())),
+            )
+        if f32_matmuls:
+            flag(
+                "dtype_policy",
+                f"f32 matmul compute under compute_dtype='bfloat16' "
+                f"(unintended upcast): {'; '.join(f32_matmuls[:4])}"
+                + (f" (+{len(f32_matmuls) - 4} more)"
+                   if len(f32_matmuls) > 4 else ""),
+            )
+
+    def _check_hlo(self, program: str, hlo_text: str,
+                   census: Dict[str, int], flag,
+                   expect_no_grouped_conv: Optional[bool]) -> None:
+        transfers = C.host_transfer_ops(hlo_text)
+        if transfers:
+            flag(
+                "no_transfer",
+                "host-transfer opcodes in the optimized HLO: "
+                + ", ".join(f"{k} x{v}" for k, v in sorted(transfers.items())),
+            )
+        n_f64 = C.f64_shape_count(hlo_text)
+        if n_f64:
+            flag("dtype_policy",
+                 f"f64 shapes in the optimized HLO ({n_f64} occurrences)")
+        if expect_no_grouped_conv is None:
+            expect_no_grouped_conv = (
+                self.cfg.resolved_conv_impl == "gemm"
+                and self.cfg.task_axis_mode == "vmap"
+            )
+        if expect_no_grouped_conv:
+            grouped = C.grouped_conv_count(hlo_text)
+            if grouped:
+                flag(
+                    "op_census",
+                    f"{grouped} grouped convolution(s) "
+                    "(feature_group_count>1) in a GEMM-lowered program — "
+                    "the conv path fell off the batched-GEMM lowering",
+                )
+        if self._census_armed:
+            key = C.census_key(program, jax.default_backend())
+            pinned = (self.baseline or {}).get("programs", {}).get(key)
+            if pinned is not None:
+                regressions = C.compare_census(census, pinned.get("census", {}))
+                if regressions:
+                    flag(
+                        "op_census",
+                        "census regression vs pinned baseline: "
+                        + ", ".join(regressions),
+                    )
+
+
+# -- the canonical program family --------------------------------------------
+
+
+def _sds(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def _batch_avals(cfg: MAMLConfig, k: int = 0):
+    """ShapeDtypeStructs of one (or k stacked) pixel task batch(es)."""
+    b, n = cfg.batch_size, cfg.num_classes_per_set
+    s, t = cfg.num_samples_per_class, cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    lead = (k,) if k else ()
+    return (
+        _sds(lead + (b, n, s, h, w, c), jnp.float32),
+        _sds(lead + (b, n, s), jnp.int32),
+        _sds(lead + (b, n, t, h, w, c), jnp.float32),
+        _sds(lead + (b, n, t), jnp.int32),
+    )
+
+
+def _index_avals(cfg: MAMLConfig, k: int = 0, store_images: int = 64):
+    """ShapeDtypeStructs of the resident store + one (or k) index batches."""
+    b, n = cfg.batch_size, cfg.num_classes_per_set
+    per = cfg.num_samples_per_class + cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    lead = (k,) if k else ()
+    store = _sds((store_images, h, w, c), jnp.uint8)
+    gather = _sds(lead + (b, n, per), jnp.int32)
+    rot_k = _sds(lead + (b, n), jnp.int32)
+    return store, gather, rot_k
+
+
+def _state_avals(cfg: MAMLConfig):
+    """The MetaState as ShapeDtypeStructs — ``eval_shape`` over init, so
+    the audit never allocates a real state."""
+    return jax.eval_shape(lambda: maml.init_state(cfg))
+
+
+def audit_system_programs(
+    cfg: MAMLConfig,
+    auditor: Optional[ProgramAuditor] = None,
+    second_order: Optional[bool] = None,
+    k: int = 2,
+    programs: Optional[Sequence[str]] = None,
+) -> List[C.AuditReport]:
+    """Audit the canonical program family the system builds.
+
+    Returns one ``AuditReport`` per program: the four train-step jits
+    (each built with ``maml.TRAIN_DONATE`` exactly like
+    ``experiment/system.py``), the fused eval multi-step, and the
+    device-pipeline index expander. ``k`` is the fused-dispatch chunk
+    used for the multi variants; ``programs`` filters by name.
+    """
+    auditor = auditor or ProgramAuditor(cfg)
+    so = cfg.second_order if second_order is None else bool(second_order)
+    state = _state_avals(cfg)
+    weights = _sds((cfg.number_of_training_steps_per_iter,), jnp.float32)
+    lr = _sds((), jnp.float32)
+    batch = _batch_avals(cfg)
+    batch_k = _batch_avals(cfg, k)
+    store, gather, rot_k = _index_avals(cfg)
+    _, gather_k, rot_k_k = _index_avals(cfg, k)
+    so_tag = int(so)
+
+    specs: List[Tuple[str, Any, tuple, tuple]] = [
+        (
+            f"train_step[so={so_tag}]",
+            jax.jit(maml.make_train_step(cfg, so),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, *batch, weights, lr),
+            maml.TRAIN_DONATE,
+        ),
+        (
+            f"train_multi_step[so={so_tag},k={k}]",
+            jax.jit(maml.make_train_multi_step(cfg, so),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, *batch_k, weights, lr),
+            maml.TRAIN_DONATE,
+        ),
+        (
+            f"train_step_indexed[so={so_tag}]",
+            jax.jit(maml.make_train_step_indexed(cfg, so, augment=False),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, store, gather, rot_k, weights, lr),
+            maml.TRAIN_DONATE,
+        ),
+        (
+            f"train_multi_step_indexed[so={so_tag},k={k}]",
+            jax.jit(maml.make_train_multi_step_indexed(cfg, so,
+                                                       augment=False),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, store, gather_k, rot_k_k, weights, lr),
+            maml.TRAIN_DONATE,
+        ),
+        (
+            f"eval_multi_step[k={k}]",
+            jax.jit(maml.make_eval_multi_step(cfg, with_preds=False)),
+            (state, *batch_k),
+            (),
+        ),
+        (
+            "index_expander",
+            jax.jit(device_pipeline.make_index_expander(cfg, augment=False)),
+            (store, gather, rot_k),
+            (),
+        ),
+    ]
+    reports = []
+    for name, jitted, args, donate in specs:
+        if programs is not None and name not in programs:
+            continue
+        reports.append(auditor.audit(name, jitted, args, donate=donate))
+    return reports
+
+
+#: the four donating train-step program-name prefixes (tests key off these)
+TRAIN_STEP_PROGRAMS = (
+    "train_step[", "train_multi_step[", "train_step_indexed[",
+    "train_multi_step_indexed[",
+)
+
+
+# -- runtime retrace detection -----------------------------------------------
+
+
+class RetraceError(RuntimeError):
+    """A dispatch site changed its abstract signature mid-run
+    (``analysis_level='strict'``)."""
+
+
+class RetraceDetector:
+    """Watch abstract dispatch signatures; flag mid-run retraces.
+
+    A *site* is one logical jitted program including its static variant
+    keys (e.g. ``train_multi_step[so=1,k=4]``); within a site, every
+    distinct abstract signature (pytree structure + leaf shapes/dtypes)
+    is a separate XLA compile. The first signature per site is the
+    expected compile; any later NEW signature is a retrace — 20-40s of
+    TPU compile mid-run that the shape discipline should have prevented.
+
+    ``observe`` costs one ``tree_flatten`` plus a tuple hash per dispatch
+    when installed; ``analysis_level='off'`` installs nothing and the
+    dispatch path pays a single attribute check (same discipline as
+    ``resilience.faults``).
+    """
+
+    def __init__(
+        self,
+        on_retrace: Optional[Callable[..., None]] = None,
+        strict: bool = False,
+    ):
+        self.on_retrace = on_retrace
+        self.strict = strict
+        self._sigs: Dict[str, set] = {}
+        self.events: List[Dict[str, Any]] = []
+
+    @staticmethod
+    def _abstract_key(tree) -> Tuple:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        descr = tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+            else ("py", type(leaf).__name__)
+            for leaf in leaves
+        )
+        return (treedef, descr)
+
+    @staticmethod
+    def signature_digest(key: Tuple) -> str:
+        blob = "|".join(str(part) for part in key[1]) + str(key[0])
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    @property
+    def retrace_count(self) -> int:
+        return len(self.events)
+
+    def observe(self, site: str, tree) -> bool:
+        """Record one dispatch; returns True (and reports) on a retrace."""
+        key = self._abstract_key(tree)
+        seen = self._sigs.setdefault(site, set())
+        if key in seen:
+            return False
+        first = not seen
+        seen.add(key)
+        if first:
+            return False
+        event = {
+            "site": site,
+            "signature": self.signature_digest(key),
+            "n_signatures": len(seen),
+        }
+        self.events.append(event)
+        if self.on_retrace is not None:
+            self.on_retrace(**event)
+        if self.strict:
+            raise RetraceError(
+                f"dispatch site {site!r} retraced mid-run: signature "
+                f"{event['signature']} is its {len(seen)}th distinct "
+                "abstract signature (analysis_level='strict')"
+            )
+        return True

@@ -1,0 +1,311 @@
+"""Program-contract vocabulary + HLO census helpers + the pinned baseline.
+
+This module is deliberately stdlib-only (``re``/``json``/``dataclasses``):
+``bench.py`` imports the census helpers for its ``hlo_cost`` / ``donation``
+fields, and the contracts/baseline plumbing must stay importable before any
+backend is settled. Everything that needs jax (tracing, compiling, walking
+jaxprs) lives in :mod:`analysis.auditor`.
+
+The contracts the auditor enforces (one name each, used in violations,
+reports and tests):
+
+* ``donation``     — the executable aliases at least the donated state's
+  bytes in place (``memory_analysis``), and jax emitted no "donated
+  buffers were not usable" diagnostic: params + LSLR + BN + Adam moments
+  stay single-buffered in HBM across dispatches (PR 4's ``TRAIN_DONATE``);
+* ``no_transfer``  — no host<->device traffic inside the step: no
+  ``device_put`` / host-callback primitives in the jaxpr, no
+  infeed/outfeed/send/recv in the optimized HLO (the index-only <1KB/step
+  H2D contract of PR 2 — all transfers happen at the dispatch boundary,
+  never mid-program);
+* ``dtype_policy`` — no f64 anywhere (x64 creep), and under
+  ``compute_dtype='bfloat16'`` no matmul-class op (dot/conv) runs with
+  f32 operands beyond scalar-loss size — an accidental upcast would
+  silently halve MXU throughput;
+* ``op_census``    — the optimized-HLO opcode census must not regress
+  against the pinned ``CONTRACTS.json`` baseline, and a config that
+  resolves to the GEMM conv path must compile with zero grouped
+  (``feature_group_count>1``) convolutions (the exact lowering regression
+  PR 4's throughput depends on).
+"""
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: the contract names, in reporting order
+CONTRACT_NAMES = ("donation", "no_transfer", "dtype_policy", "op_census")
+
+#: op classes that distinguish a healthy lowering from a regressed one —
+#: the census the baseline pins and the regression check compares (the full
+#: census would drown the signal in elementwise noise). Shared with
+#: bench.py's ``hlo_cost`` field.
+INTERESTING_OPS = (
+    "dot", "convolution", "fusion", "custom-call", "all-reduce",
+    "all-gather", "reduce-scatter", "copy", "transpose", "pad",
+    "gather", "scatter", "while",
+)
+
+#: scalar cost_analysis keys surfaced whole by ``hlo_cost_breakdown``
+HLO_SCALAR_KEYS = ("flops", "transcendentals", "bytes accessed",
+                   "optimal_seconds")
+
+#: HLO opcodes that ARE host<->device traffic (send/recv also cover the
+#: host-transfer forms; within-device collectives are not in this list)
+HOST_TRANSFER_HLO_OPS = ("infeed", "outfeed", "send", "recv",
+                         "send-done", "recv-done")
+
+
+@dataclass(frozen=True)
+class ContractViolation:
+    """One broken contract on one program."""
+
+    contract: str  # one of CONTRACT_NAMES
+    program: str   # e.g. "train_step[so=1]"
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.contract}] {self.program}: {self.detail}"
+
+
+class AuditError(RuntimeError):
+    """Raised under ``analysis_level='strict'`` when contracts are broken."""
+
+    def __init__(self, violations: List[ContractViolation]):
+        self.violations = list(violations)
+        lines = "\n  ".join(str(v) for v in self.violations)
+        super().__init__(
+            f"{len(self.violations)} program-contract violation(s):\n  {lines}"
+        )
+
+
+@dataclass
+class AuditReport:
+    """What one program's audit found (violations may be empty)."""
+
+    program: str
+    backend: str
+    contracts_checked: Tuple[str, ...]
+    violations: List[ContractViolation] = field(default_factory=list)
+    census: Dict[str, int] = field(default_factory=dict)
+    donation: Optional[Dict[str, int]] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# -- optimized-HLO text analysis ---------------------------------------------
+
+
+def hlo_op_census(hlo_text: str) -> Dict[str, int]:
+    """Instruction counts per opcode over an optimized-HLO dump.
+
+    Counts every ``= <shape> <opcode>(`` instruction; callers usually
+    filter to ``INTERESTING_OPS``. This is the census bench.py's
+    ``hlo_cost`` field records and the ``op_census`` contract pins.
+    """
+    ops: Dict[str, int] = {}
+    for m in re.finditer(r"=\s+\S+\s+([a-z][a-z0-9-]*)\(", hlo_text):
+        ops[m.group(1)] = ops.get(m.group(1), 0) + 1
+    return ops
+
+
+def interesting_census(hlo_text: str) -> Dict[str, int]:
+    ops = hlo_op_census(hlo_text)
+    return {k: ops[k] for k in INTERESTING_OPS if k in ops}
+
+
+def grouped_conv_count(hlo_text: str) -> int:
+    """Number of ``convolution`` instructions with ``feature_group_count>1``
+    — the grouped-conv lowering the GEMM path exists to eliminate."""
+    return sum(
+        1
+        for m in re.finditer(r"feature_group_count=(\d+)", hlo_text)
+        if int(m.group(1)) > 1
+    )
+
+
+def host_transfer_ops(hlo_text: str) -> Dict[str, int]:
+    """Census of host<->device transfer opcodes in an optimized-HLO dump."""
+    ops = hlo_op_census(hlo_text)
+    return {k: ops[k] for k in HOST_TRANSFER_HLO_OPS if k in ops}
+
+
+def f64_shape_count(hlo_text: str) -> int:
+    """Occurrences of an ``f64[...]`` shape anywhere in the HLO text."""
+    return len(re.findall(r"\bf64\[", hlo_text))
+
+
+# -- compiled-executable helpers (shared with bench.py) ----------------------
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one dict (older jax
+    returns ``[dict]``, newer a plain dict) — the single normalization
+    point for bench.py and the auditor."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return ca
+
+
+def hlo_cost_breakdown(compiled, ca: dict) -> Optional[dict]:
+    """Per-category HLO cost summary of a compiled executable.
+
+    Combines XLA's cost analysis ``ca`` (total flops / bytes accessed, plus
+    any per-category entries the backend exposes) with the opcode census of
+    the optimized HLO, so a lowering regression (e.g. the task-batched GEMM
+    conv silently falling back to grouped convolutions) is visible in the
+    BENCH_* trajectory without a profiler. Best-effort: returns None when
+    the backend exposes neither surface.
+    """
+    import sys
+
+    out: dict = {}
+    try:
+        for key in HLO_SCALAR_KEYS:
+            if key in ca:
+                out[key.replace(" ", "_")] = float(ca[key])
+        breakdown = {
+            k: float(v)
+            for k, v in ca.items()
+            if k not in HLO_SCALAR_KEYS
+            and not re.fullmatch(r"(bytes accessed|utilization)\w*\{\}", k)
+        }
+        if breakdown:
+            out["cost_breakdown"] = breakdown
+    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
+        print(f"analysis: cost_analysis breakdown unavailable ({e!r})",
+              file=sys.stderr)
+    try:
+        census = interesting_census(compiled.as_text())
+        if census:
+            out["hlo_op_counts"] = census
+    except Exception as e:  # noqa: BLE001
+        print(f"analysis: HLO op census unavailable ({e!r})", file=sys.stderr)
+    return out or None
+
+
+def donation_stats(compiled, donate_argnums) -> Optional[dict]:
+    """Aliasing/donation figures of a compiled step: a donation regression
+    (state no longer aliased in place -> double-buffered params+Adam in HBM)
+    shows up as alias_size_bytes collapsing toward zero."""
+    import sys
+
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "donate_argnums": list(donate_argnums),
+            "alias_size_bytes": int(ma.alias_size_in_bytes),
+            "argument_size_bytes": int(ma.argument_size_in_bytes),
+            "output_size_bytes": int(ma.output_size_in_bytes),
+            "temp_size_bytes": int(ma.temp_size_in_bytes),
+        }
+    except Exception as e:  # noqa: BLE001 - memory analysis is best-effort
+        print(f"analysis: memory_analysis unavailable ({e!r})",
+              file=sys.stderr)
+        return {"donate_argnums": list(donate_argnums)}
+
+
+# -- the pinned baseline (CONTRACTS.json) ------------------------------------
+
+BASELINE_VERSION = 1
+BASELINE_FILENAME = "CONTRACTS.json"
+
+
+def default_baseline_path() -> str:
+    """``CONTRACTS.json`` at the repository root (two levels above this
+    package). May not exist — e.g. for an installed wheel — in which case
+    the census-regression check is simply skipped."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        BASELINE_FILENAME,
+    )
+
+
+def census_key(program: str, backend: str) -> str:
+    return f"{program}@{backend}"
+
+
+def load_baseline(path: Optional[str] = None) -> Optional[dict]:
+    """Parse a pinned baseline, or None when absent/unreadable (the
+    regression check degrades to the invariant constraints only)."""
+    path = path or default_baseline_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or "programs" not in data:
+        return None
+    return data
+
+
+def save_baseline(path: str, *, jax_version: str, backend: str,
+                  config_fingerprint: str,
+                  reports: List[AuditReport]) -> dict:
+    """Re-pin the baseline from a set of audit reports (``cli audit
+    --pin``). The jax version and config fingerprint are recorded so a
+    later compare against a different toolchain or audit config skips
+    with a note instead of producing phantom regressions."""
+    data = {
+        "version": BASELINE_VERSION,
+        "jax": jax_version,
+        "backend": backend,
+        "config_fingerprint": config_fingerprint,
+        "programs": {
+            census_key(r.program, r.backend): {
+                "census": dict(r.census),
+                "alias_size_bytes": (
+                    (r.donation or {}).get("alias_size_bytes")
+                ),
+            }
+            for r in reports
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def baseline_comparable(baseline: Optional[dict], *, jax_version: str,
+                        config_fingerprint: str) -> bool:
+    """A baseline only yields regression signals when it was pinned with
+    the same jax (XLA rewrites change op counts release to release) and
+    the same audit config (shapes change the census legitimately)."""
+    return bool(
+        baseline
+        and baseline.get("jax") == jax_version
+        and baseline.get("config_fingerprint") == config_fingerprint
+    )
+
+
+def compare_census(current: Dict[str, int], pinned: Dict[str, int],
+                   ) -> List[str]:
+    """Regressions of ``current`` vs the pinned census: any interesting op
+    class that grew, or appeared where the baseline had none. Shrinkage is
+    an improvement, reported by ``cli audit`` as a re-pin suggestion, never
+    a violation."""
+    regressions = []
+    for op in INTERESTING_OPS:
+        now = int(current.get(op, 0))
+        then = int(pinned.get(op, 0))
+        if now > then:
+            regressions.append(f"{op}: {then} -> {now}")
+    return regressions
+
+
+def config_fingerprint(cfg_dict: dict) -> str:
+    """Stable fingerprint of the audit config (shape-relevant keys only
+    would invite drift bugs; hash the whole dict, sorted)."""
+    import hashlib
+
+    blob = json.dumps(cfg_dict, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]

@@ -1,0 +1,401 @@
+"""Repo-specific JAX-pitfall lint pass (AST-based, jax-free).
+
+Generic Python hygiene is ruff's job (config in ``pyproject.toml``); this
+linter carries only the rules that need repo knowledge — which seams are
+traced, which must donate, which I/O must retry:
+
+* **MP001** — host operations inside traced code: within ``core/`` and
+  ``ops/``, a function scope that does jax math (uses ``jnp.`` / ``lax.``
+  / ``jax.lax`` / ``jax.vmap`` / ...) must not call ``np.*``, ``.item()``,
+  ``float()`` / ``int()``, ``print()`` or ``open()`` — each is a silent
+  device->host sync, a trace-time constant bake, or a side effect that
+  breaks under jit;
+* **MP002** — a ``jax.jit`` of a ``make_train*`` factory without
+  ``donate_argnums``: every train-step executable must donate the state
+  (``maml.TRAIN_DONATE``) or params+Adam double-buffer in HBM;
+* **MP003** — a telemetry record built outside ``schema``'s blessed
+  constructor: any dict literal with a ``"schema"`` key outside
+  ``telemetry/sinks.py`` (``make_record`` is the single construction
+  point — hand-rolled records skip the non-finite masking and version
+  stamping);
+* **MP004** — checkpoint/statistics I/O in ``experiment/builder.py`` not
+  routed through ``resilience.retry`` (the ``retry.call(lambda: ...)`` /
+  ``_write_stats(lambda: ...)`` seams): a bare call turns a transient
+  filesystem fault into a dead run;
+* **MP005** — a suppression comment without a reason (suppressions are
+  ``# lint-ok: MPnnn <reason>`` on the offending line; the reason is
+  mandatory and the rule id must exist).
+
+Run via ``python -m howtotrainyourmamlpytorch_tpu.cli lint [paths...]``
+(defaults to the package + ``bench.py``); exits nonzero on violations.
+Pure stdlib — works on a machine with neither jax nor numpy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set
+
+RULES: Dict[str, str] = {
+    "MP001": "host operation inside traced code (core/ and ops/)",
+    "MP002": "jax.jit of a make_train* factory without donate_argnums",
+    "MP003": "telemetry record constructed outside schema's make_record",
+    "MP004": "checkpoint/stats I/O not routed through resilience.retry",
+    "MP005": "lint suppression without a reason",
+}
+
+#: builtins whose call inside a traced scope forces a host sync or bakes a
+#: trace-time constant
+_HOST_BUILTINS = ("float", "int", "print", "open")
+
+#: I/O seams MP004 requires behind a retry lambda in the builder
+_RETRY_FUNCS = {"save_statistics", "save_to_json"}
+_RETRY_METHODS = {"save_model", "load_model", "save_checkpoint",
+                  "save_checkpoint_async", "load_checkpoint"}
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(MP\d{3})\b[ \t]*(.*\S)?")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute chain ('jax.lax.scan'), '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    """Module-level aliases bound to numpy ('np' usually)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy" or a.name.startswith("numpy."):
+                    aliases.add(a.asname or a.name.split(".")[0])
+    return aliases or {"np", "numpy"}
+
+
+def _jax_math_aliases(tree: ast.Module) -> Set[str]:
+    """Aliases whose use marks a scope as traced jax math: jax.numpy,
+    jax.lax (however imported)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax.numpy", "jax.lax"):
+                    aliases.add(a.asname or a.name.split(".")[-1])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name in ("numpy", "lax"):
+                        aliases.add(a.asname or a.name)
+            elif node.module in ("jax.numpy", "jax.lax"):
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+    return aliases or {"jnp", "lax"}
+
+
+#: jax.* attribute roots that also mark a scope as traced math
+_JAX_TRACED_ATTRS = ("jax.lax.", "jax.nn.", "jax.vmap", "jax.grad",
+                     "jax.value_and_grad", "jax.checkpoint")
+
+
+class _ScopeInfo:
+    def __init__(self, node: ast.AST):
+        self.node = node
+        self.uses_jax_math = False
+        self.hits: List[Violation] = []
+
+
+def _check_traced_host_ops(path: str, tree: ast.Module) -> List[Violation]:
+    """MP001 — per function scope: jax math + host ops don't mix."""
+    np_aliases = _numpy_aliases(tree)
+    jm_aliases = _jax_math_aliases(tree)
+    out: List[Violation] = []
+
+    def scan_scope(fn_node) -> None:
+        """One function scope: its own statements, not nested defs."""
+        uses_math = False
+        hits: List[tuple] = []
+
+        def visit(node, top: bool):
+            nonlocal uses_math
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    scan_scope(child)
+                    continue
+                if isinstance(child, ast.Lambda):
+                    # lambdas share the enclosing scope's traced-ness
+                    pass
+                chain = ""
+                if isinstance(child, ast.Attribute):
+                    chain = _attr_chain(child)
+                elif isinstance(child, ast.Name):
+                    chain = child.id
+                if chain:
+                    root = chain.split(".")[0]
+                    if root in jm_aliases or any(
+                        chain.startswith(p) for p in _JAX_TRACED_ATTRS
+                    ):
+                        uses_math = True
+                if isinstance(child, ast.Call):
+                    func = child.func
+                    fchain = _attr_chain(func) if isinstance(
+                        func, (ast.Attribute, ast.Name)
+                    ) else ""
+                    if fchain.split(".")[0] in np_aliases and "." in fchain:
+                        hits.append((child.lineno,
+                                     f"call to {fchain}() in a traced scope"))
+                    elif isinstance(func, ast.Attribute) and \
+                            func.attr == "item":
+                        hits.append((child.lineno,
+                                     "'.item()' forces a device->host sync "
+                                     "in a traced scope"))
+                    elif isinstance(func, ast.Name) and \
+                            func.id in _HOST_BUILTINS:
+                        hits.append((child.lineno,
+                                     f"call to {func.id}() in a traced "
+                                     "scope"))
+                visit(child, False)
+
+        visit(fn_node, True)
+        if uses_math:
+            out.extend(
+                Violation(path, line, "MP001", msg) for line, msg in hits
+            )
+
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan_scope(item)
+    return out
+
+
+def _check_jit_donation(path: str, tree: ast.Module) -> List[Violation]:
+    """MP002 — jax.jit(...make_train*...) must pass donate_argnums."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func) if isinstance(
+            node.func, (ast.Attribute, ast.Name)
+        ) else ""
+        if not (chain == "jit" or chain.endswith(".jit")):
+            continue
+        mentions_train_factory = any(
+            "make_train" in (_attr_chain(sub) or "")
+            for arg in node.args
+            for sub in ast.walk(arg)
+            if isinstance(sub, (ast.Attribute, ast.Name))
+        )
+        if not mentions_train_factory:
+            continue
+        if not any(kw.arg == "donate_argnums" for kw in node.keywords):
+            out.append(Violation(
+                path, node.lineno, "MP002",
+                "jax.jit of a make_train* factory without donate_argnums "
+                "(state double-buffers in HBM; use maml.TRAIN_DONATE)",
+            ))
+    return out
+
+
+def _check_schema_bypass(path: str, tree: ast.Module) -> List[Violation]:
+    """MP003 — dict literals with a "schema" key outside make_record."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key in node.keys:
+            if isinstance(key, ast.Constant) and key.value == "schema":
+                out.append(Violation(
+                    path, node.lineno, "MP003",
+                    "telemetry record built by hand (dict with a 'schema' "
+                    "key); route it through telemetry.sinks.make_record",
+                ))
+    return out
+
+
+def _check_unrouted_io(path: str, tree: ast.Module) -> List[Violation]:
+    """MP004 — builder I/O seams must sit behind a retry lambda."""
+    out: List[Violation] = []
+
+    def visit(node, in_lambda: bool):
+        for child in ast.iter_child_nodes(node):
+            child_in_lambda = in_lambda or isinstance(child, ast.Lambda)
+            if isinstance(child, ast.Call) and not child_in_lambda:
+                func = child.func
+                name = ""
+                if isinstance(func, ast.Name):
+                    name = func.id
+                elif isinstance(func, ast.Attribute):
+                    name = func.attr
+                if name in _RETRY_FUNCS or name in _RETRY_METHODS:
+                    out.append(Violation(
+                        path, child.lineno, "MP004",
+                        f"direct call to {name}() — route it through "
+                        "resilience.retry (retry.call(lambda: ...) or "
+                        "_write_stats(lambda: ...)) so transient I/O "
+                        "faults are retried",
+                    ))
+            visit(child, child_in_lambda)
+
+    visit(tree, False)
+    return out
+
+
+def _apply_suppressions(
+    violations: List[Violation], path: str, source_lines: List[str]
+) -> List[Violation]:
+    """Drop violations whose line carries a matching reasoned suppression;
+    flag malformed suppressions (MP005)."""
+    suppressions: Dict[int, tuple] = {}
+    out: List[Violation] = []
+    for lineno, line in enumerate(source_lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rule, reason = m.group(1), (m.group(2) or "").strip()
+        if rule not in RULES:
+            out.append(Violation(
+                path, lineno, "MP005",
+                f"suppression names unknown rule {rule!r}",
+            ))
+        elif not reason:
+            out.append(Violation(
+                path, lineno, "MP005",
+                f"suppression of {rule} without a reason — justify it "
+                "(# lint-ok: MPnnn <why this is safe>)",
+            ))
+        else:
+            suppressions[lineno] = (rule, reason)
+    for v in violations:
+        sup = suppressions.get(v.line)
+        if sup is not None and sup[0] == v.rule:
+            continue
+        out.append(v)
+    return out
+
+
+def _package_relpath(path: str) -> Optional[str]:
+    """Path relative to the package root, or None when outside it."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    if "howtotrainyourmamlpytorch_tpu" in parts:
+        i = parts.index("howtotrainyourmamlpytorch_tpu")
+        return "/".join(parts[i + 1:])
+    return None
+
+
+def lint_file(path: str) -> List[Violation]:
+    """Lint one Python file with the rules that apply to its location."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "MP001",
+                          f"file does not parse: {e.msg}")]
+    rel = _package_relpath(path)
+    violations: List[Violation] = []
+    if rel is not None and rel.split("/")[0] in ("core", "ops"):
+        violations += _check_traced_host_ops(path, tree)
+    violations += _check_jit_donation(path, tree)
+    if rel not in ("telemetry/sinks.py", "telemetry/schema.py"):
+        violations += _check_schema_bypass(path, tree)
+    if rel == "experiment/builder.py":
+        violations += _check_unrouted_io(path, tree)
+    return _apply_suppressions(violations, path, source.splitlines())
+
+
+def iter_python_files(paths: Sequence[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    yield os.path.join(root, fn)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Violation]:
+    violations: List[Violation] = []
+    for path in iter_python_files(paths):
+        violations += lint_file(path)
+    return violations
+
+
+def default_paths() -> List[str]:
+    """The package itself plus bench.py at the repo root (when present)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg]
+    bench = os.path.join(os.path.dirname(pkg), "bench.py")
+    if os.path.isfile(bench):
+        paths.append(bench)
+    return paths
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="lint",
+        description="JAX-pitfall lint pass (repo-specific rules; generic "
+                    "Python hygiene is ruff's job)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the package + bench.py)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    paths = list(args.paths) or default_paths()
+    violations = lint_paths(paths)
+    if args.json:
+        print(json.dumps(
+            [v.__dict__ for v in violations], indent=2, sort_keys=True
+        ))
+    else:
+        for v in violations:
+            print(v)
+        n_files = sum(1 for _ in iter_python_files(paths))
+        print(
+            f"lint: {len(violations)} violation(s) in {n_files} file(s)",
+            file=sys.stderr,
+        )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,15 @@ The ``inspect`` subcommand is the telemetry reader
 It is dispatched before any jax-importing module loads, so inspection
 works on a machine with nothing but the repo and numpy installed.
 
+The ``lint`` subcommand (analysis/lint.py — pure stdlib, also dispatched
+jax-free) runs the repo-specific JAX-pitfall linter; the ``audit``
+subcommand (tools/audit_cli.py — needs jax) statically verifies the
+program contracts (donation / no-transfer / dtype policy / op census)
+on the jitted program family:
+
+    python -m howtotrainyourmamlpytorch_tpu.cli lint
+    python -m howtotrainyourmamlpytorch_tpu.cli audit [--pin]
+
 Exit codes: 0 on success; ``resilience.PREEMPT_EXIT_CODE`` (75) when a
 SIGTERM/SIGINT preemption was drained gracefully (emergency checkpoint on
 disk — restart with ``continue_from_epoch=latest`` to resume at the exact
@@ -88,6 +97,16 @@ def main(argv=None):
         from .tools.telemetry_cli import main as telemetry_main
 
         raise SystemExit(telemetry_main(args[1:]))
+    if args and args[0] == "lint":
+        # repo-specific JAX-pitfall linter: pure stdlib, jax-free
+        from .analysis.lint import main as lint_main
+
+        raise SystemExit(lint_main(args[1:]))
+    if args and args[0] == "audit":
+        # program-contract auditor (compiles programs: needs jax)
+        from .tools.audit_cli import main as audit_main
+
+        raise SystemExit(audit_main(args[1:]))
     from .data.loader import MetaLearningDataLoader
     from .experiment.builder import ExperimentBuilder
     from .experiment.system import MAMLFewShotClassifier

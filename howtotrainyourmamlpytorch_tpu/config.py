@@ -305,6 +305,27 @@ class MAMLConfig:
     # the process's default signal behaviour (die, lose up to an epoch).
     handle_preemption_signals: bool = True
 
+    # --- static analysis (analysis/) --------------------------------------
+    # program-contract audits + runtime retrace detection:
+    # 'off'    — (default) nothing installed; the jitted programs and the
+    #            dispatch paths are bit-identical to a pre-analysis build
+    #            (tested, same discipline as fault_spec/telemetry off);
+    # 'warn'   — at program-build time the builder audits the canonical
+    #            program family (donation honored, no host<->device
+    #            transfer inside the step, dtype policy, op-census — the
+    #            CONTRACTS.json regression compare arms only when the
+    #            baseline was pinned for this jax version and config
+    #            fingerprint, otherwise it is skipped with a logged note
+    #            while the invariant census constraints still run) and
+    #            logs violations; at
+    #            run time every dispatch site's abstract signature is
+    #            hashed and a mid-run retrace emits a telemetry `retrace`
+    #            record (schema v4) plus a stderr warning;
+    # 'strict' — the same checks, but contract violations fail the build
+    #            (analysis.AuditError) and a retrace fails the run
+    #            (analysis.auditor.RetraceError).
+    analysis_level: str = "off"  # 'off' | 'warn' | 'strict'
+
     # persistent XLA compilation cache: resumed runs (and repeated runs of
     # the same config) skip the 20-40s TPU compile of the train/eval steps.
     # 'auto' (default) => <experiment_dir>/xla_cache, resolved by the
@@ -428,6 +449,11 @@ class MAMLConfig:
             raise ValueError(
                 f"telemetry_level must be 'off', 'scalars' or 'dynamics', "
                 f"got {self.telemetry_level!r}"
+            )
+        if self.analysis_level not in ("off", "warn", "strict"):
+            raise ValueError(
+                f"analysis_level must be 'off', 'warn' or 'strict', got "
+                f"{self.analysis_level!r}"
             )
         if self.health_level not in ("off", "monitor", "halt"):
             raise ValueError(

@@ -24,7 +24,7 @@ Faithful quirks preserved:
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Tuple, Union
+from typing import Dict, NamedTuple, Union
 
 import numpy as np
 

@@ -256,6 +256,14 @@ class ExperimentBuilder:
                     else self._dump_state_for_incident
                 ),
             )
+        # static analysis (analysis/): program-contract audit at build time
+        # + runtime retrace detector on the dispatch sites. 'off' (default)
+        # installs nothing — the system's dispatch paths keep a single
+        # attribute check and the jitted programs are bit-identical to a
+        # pre-analysis build (tested).
+        self.retrace_detector = None
+        if cfg.analysis_level != "off":
+            self._install_analysis()
         # on-device dynamics stacks (telemetry_level='dynamics') buffered as
         # DEVICE arrays per dispatch; converted + flushed at epoch-summary
         # time so collection never adds a host sync to the hot loop
@@ -659,6 +667,96 @@ class ExperimentBuilder:
                 )
         raise PreemptedError(signum, it, ckpt_path)
 
+    # -- static analysis plumbing (analysis/) ------------------------------
+
+    def _install_analysis(self) -> None:
+        """``analysis_level != 'off'``: audit the canonical program family
+        against the pinned contracts NOW — before an epoch of compute is
+        sunk into a program that double-buffers its state or re-compiles
+        every dispatch — and install the runtime retrace detector on the
+        system's dispatch sites. 'warn' logs violations and telemeters
+        retraces; 'strict' raises (AuditError here, RetraceError at the
+        offending dispatch)."""
+        import dataclasses as _dc
+
+        import jax
+
+        from ..analysis import auditor as audit_lib
+        from ..analysis import contracts as contracts_lib
+
+        cfg = self.cfg
+        strict = cfg.analysis_level == "strict"
+        if jax.process_count() > 1:
+            self._log(
+                "[analysis] build-time program audit skipped on multihost "
+                "runs (every process would compile the audit family); the "
+                "retrace detector is still installed"
+            )
+        else:
+            baseline = contracts_lib.load_baseline()
+            fingerprint = contracts_lib.config_fingerprint(
+                _dc.asdict(cfg)
+            )
+            if baseline is not None and not contracts_lib.baseline_comparable(
+                baseline,
+                jax_version=jax.__version__,
+                config_fingerprint=fingerprint,
+            ):
+                # CONTRACTS.json is pinned against the canonical audit
+                # config (cli audit --pin); a real experiment config (or a
+                # different jax) disarms the census-regression compare —
+                # say so, the invariant contracts still run
+                self._log(
+                    "[analysis] pinned CONTRACTS.json baseline is not "
+                    "comparable to this run (different jax version or "
+                    "audit config); op-census regression check skipped, "
+                    "invariant contracts still enforced"
+                )
+            auditor = audit_lib.ProgramAuditor(
+                cfg, baseline=baseline, config_fingerprint=fingerprint
+            )
+            reports = audit_lib.audit_system_programs(cfg, auditor=auditor)
+            violations = [v for r in reports for v in r.violations]
+            for v in violations:
+                print(f"[analysis] CONTRACT VIOLATION {v}",
+                      file=sys.stderr, flush=True)
+            self._log(
+                f"[analysis] program audit: {len(reports)} program(s), "
+                f"{len(violations)} violation(s)"
+            )
+            if violations and strict:
+                raise contracts_lib.AuditError(violations)
+        self.retrace_detector = audit_lib.RetraceDetector(
+            on_retrace=self._on_retrace, strict=strict
+        )
+        self.model.retrace_detector = self.retrace_detector
+
+    def _on_retrace(self, site: str, signature: str,
+                    n_signatures: int) -> None:
+        """RetraceDetector callback: one loud stderr line + a telemetry
+        ``retrace`` record (schema v4) + a flight-recorder note per mid-run
+        retrace — runs BEFORE the strict-mode raise, so even a fatal
+        retrace documents itself."""
+        it = int(self.state["current_iter"])
+        print(
+            f"[analysis] RETRACE at iter {it}: dispatch site {site!r} "
+            f"compiled its {n_signatures}th distinct abstract signature "
+            f"({signature}) — mid-run recompiles should never happen",
+            file=sys.stderr,
+            flush=True,
+        )
+        self.telemetry.event(
+            "retrace",
+            iter=it,
+            site=site,
+            signature=signature,
+            n_signatures=int(n_signatures),
+        )
+        if self.flight_recorder is not None:
+            self.flight_recorder.note_event(
+                "retrace", iter=it, site=site, signature=signature,
+            )
+
     # -- telemetry plumbing ------------------------------------------------
 
     def _beat(self, stage: str):
@@ -771,8 +869,13 @@ class ExperimentBuilder:
         anomaly = mon.halt_anomaly or {}
         it = int(anomaly.get("iter", self.state["current_iter"]))
         self._beat("emergency_checkpoint")
-        ckpt_path = self.model.save_model(
-            self.saved_models_filepath, "emergency", self.state,
+        # essential write behind the retry seam: a transient fault must not
+        # lose the divergent state the postmortem needs
+        ckpt_path = self.retry.call(
+            lambda: self.model.save_model(
+                self.saved_models_filepath, "emergency", self.state,
+            ),
+            site="ckpt_save",
         )
         ckpt.wait_for_pending()  # on disk before the raise, not after
         dump_dir = None
@@ -1486,9 +1589,15 @@ class ExperimentBuilder:
 
         for idx, model_idx in enumerate(sorted_idx):
             # checkpoint of epoch (model_idx + 1) — the reference's off-by-one
-            # (experiment_builder.py:265): epoch counter is 1-based at save
-            self.state = self.model.load_model(
-                self.saved_models_filepath, int(model_idx) + 1
+            # (experiment_builder.py:265): epoch counter is 1-based at save.
+            # Behind the retry seam: a transient restore fault mid-ensemble
+            # must not throw away the whole training run's final test.
+            epoch_idx = int(model_idx) + 1
+            self.state = self.retry.call(
+                lambda: self.model.load_model(
+                    self.saved_models_filepath, epoch_idx
+                ),
+                site="ckpt_restore",
             )
             pending: List = []
             for test_sample in self.data.get_test_batches(total_batches=n_batches):

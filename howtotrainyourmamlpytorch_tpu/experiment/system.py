@@ -162,6 +162,22 @@ class MAMLFewShotClassifier:
         # step (backpressure against queued-input OOM) while still
         # overlapping host work with device compute
         self._pending_sync = None
+        # runtime retrace detector (analysis/auditor.py), installed by the
+        # experiment builder when cfg.analysis_level != 'off'; None keeps
+        # every dispatch at a single attribute check (same off-path
+        # discipline as resilience.faults)
+        self.retrace_detector = None
+
+    def _observe_dispatch(self, site: str, args: tuple) -> None:
+        """Hash the abstract signature of a dispatch for the retrace
+        detector. ``site`` carries every static variant key of the jitted
+        program (second_order/augment/k/preds — and the dataset split for
+        indexed dispatches, whose per-set resident stores legitimately
+        differ in shape), so within one site any NEW signature is a
+        genuine mid-run retrace. Callers guard on ``retrace_detector is
+        not None`` BEFORE building the site string/args tuple, so the
+        'off' dispatch path stays a single attribute check."""
+        self.retrace_detector.observe(site, args)
 
     # -- step selection ---------------------------------------------------
 
@@ -410,6 +426,12 @@ class MAMLFewShotClassifier:
             store, (gather, rot_k), augment = self._stage_indexed(
                 data_batch, stacked=False
             )
+            if self.retrace_detector is not None:
+                self._observe_dispatch(
+                    f"train_step_indexed[so={int(second_order)},"
+                    f"aug={int(augment)},set={data_batch.set_name}]",
+                    (self.state, store, gather, rot_k, weights, lr),
+                )
             self.state, metrics = self._train_step_indexed(
                 second_order, augment
             )(self.state, store, gather, rot_k, weights, lr)
@@ -426,6 +448,11 @@ class MAMLFewShotClassifier:
         # serialize host and device completely.)
         if self._pending_sync is not None:
             jax.block_until_ready(self._pending_sync)
+        if self.retrace_detector is not None:
+            self._observe_dispatch(
+                f"train_step[so={int(second_order)}]",
+                (self.state, x_s, y_s, x_t, y_t, weights, lr),
+            )
         self.state, metrics = self._train_step(second_order)(
             self.state, x_s, y_s, x_t, y_t, weights, lr
         )
@@ -477,6 +504,13 @@ class MAMLFewShotClassifier:
             store, placed, augment = self._stage_indexed(
                 data_batches, stacked=True
             )
+            if self.retrace_detector is not None:
+                self._observe_dispatch(
+                    f"train_multi_step_indexed[so={int(second_order)},"
+                    f"aug={int(augment)},k={k},"
+                    f"set={data_batches[0].set_name}]",
+                    (self.state, store, *placed, weights, lr),
+                )
             self.state, metrics = self._train_multi_step_indexed(
                 second_order, augment, k
             )(self.state, store, *placed, weights, lr)
@@ -492,6 +526,11 @@ class MAMLFewShotClassifier:
         # to one in-flight dispatch while this chunk's H2D streams in
         if self._pending_sync is not None:
             jax.block_until_ready(self._pending_sync)
+        if self.retrace_detector is not None:
+            self._observe_dispatch(
+                f"train_multi_step[so={int(second_order)},k={k}]",
+                (self.state, *stacked, weights, lr),
+            )
         self.state, metrics = self._train_multi_step(second_order, k)(
             self.state, *stacked, weights, lr
         )
@@ -516,6 +555,12 @@ class MAMLFewShotClassifier:
             store, (gather, rot_k), augment = self._stage_indexed(
                 data_batch, stacked=False
             )
+            if self.retrace_detector is not None:
+                self._observe_dispatch(
+                    f"eval_step_indexed[aug={int(augment)},"
+                    f"set={data_batch.set_name}]",
+                    (self.state, store, gather, rot_k),
+                )
             metrics, preds = self._eval_step_indexed(augment)(
                 self.state, store, gather, rot_k
             )
@@ -523,6 +568,10 @@ class MAMLFewShotClassifier:
             x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
             if self._pending_sync is not None:  # same one-step pipeline as train
                 jax.block_until_ready(self._pending_sync)
+            if self.retrace_detector is not None:
+                self._observe_dispatch(
+                    "eval_step", (self.state, x_s, y_s, x_t, y_t)
+                )
             metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
         self._pending_sync = metrics["loss"]
         metrics = dict(metrics)  # device arrays; caller converts on summary
@@ -570,6 +619,13 @@ class MAMLFewShotClassifier:
             store, placed, augment = self._stage_indexed(
                 data_batches, stacked=True
             )
+            if self.retrace_detector is not None:
+                self._observe_dispatch(
+                    f"eval_multi_step_indexed[preds={int(return_preds)},"
+                    f"aug={int(augment)},k={len(data_batches)},"
+                    f"set={data_batches[0].set_name}]",
+                    (self.state, store, *placed),
+                )
             metrics, preds = self._eval_multi_step_indexed(
                 return_preds, augment
             )(self.state, store, *placed)
@@ -578,6 +634,12 @@ class MAMLFewShotClassifier:
             stacked = self._upload_stacked(prepared)
             if self._pending_sync is not None:  # same one-step pipeline as train
                 jax.block_until_ready(self._pending_sync)
+            if self.retrace_detector is not None:
+                self._observe_dispatch(
+                    f"eval_multi_step[preds={int(return_preds)},"
+                    f"k={len(data_batches)}]",
+                    (self.state, *stacked),
+                )
             metrics, preds = self._eval_multi_step(return_preds)(
                 self.state, *stacked
             )

@@ -25,7 +25,7 @@ inner-step axis and are indexed by the current inner step.
 from __future__ import annotations
 
 import math
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

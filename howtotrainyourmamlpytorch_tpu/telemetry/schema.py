@@ -43,7 +43,15 @@ Record kinds:
 * ``preemption``     — a SIGTERM/SIGINT preemption was drained at the
   dispatch boundary: the iteration, the signal number, and the resumable
   emergency checkpoint path the run exited behind (exit code
-  ``resilience.PREEMPT_EXIT_CODE``).
+  ``resilience.PREEMPT_EXIT_CODE``);
+* ``retrace``        — a dispatch site re-compiled mid-run
+  (analysis/auditor.py's RetraceDetector, ``analysis_level != 'off'``):
+  the iteration, the dispatch ``site`` (the jitted program incl. its
+  static variant keys), the new abstract-signature hash and how many
+  distinct signatures the site has now compiled. Every retrace is 20-40s
+  of TPU compile the shape discipline should have prevented; under
+  ``analysis_level='strict'`` the record is followed by a fatal
+  RetraceError.
 
 Version history / migration notes:
 
@@ -64,9 +72,15 @@ Version history / migration notes:
   resilience subsystem: retrying I/O seams and graceful preemption
   exits). Pure additions again: every v1/v2 record validates unchanged
   and the v2 forward-compat rules carry over verbatim (pinned fixtures
-  ``tests/fixtures/telemetry_future_schema.jsonl`` — a newer-than-v3
+  ``tests/fixtures/telemetry_future_schema.jsonl`` — a newer-than-current
   writer — and ``tests/fixtures/telemetry_v2_schema.jsonl`` — a v2-era
   log — cover both directions).
+* **v4** — adds the ``retrace`` record kind (the static-analysis
+  subsystem's runtime retrace detector, ``analysis_level != 'off'``).
+  Pure addition: every v1..v3 record validates unchanged
+  (``tests/fixtures/telemetry_v3_schema.jsonl`` pins a v3-era log) and
+  the forward-compat rules carry over (the future-schema fixture is
+  re-pinned at v5-unknown).
 """
 
 from __future__ import annotations
@@ -74,7 +88,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -96,6 +110,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "incident": ("iter", "reason", "path"),
     "retry": ("site", "attempt", "max_attempts", "error"),
     "preemption": ("iter", "signal", "checkpoint"),
+    "retrace": ("iter", "site", "signature"),
 }
 
 

@@ -1,0 +1,177 @@
+"""``cli audit`` — run the program-contract auditor from the command line.
+
+Audits the canonical program family (the four donating train-step jits,
+the fused eval multi-step, the device-pipeline index expander — see
+``analysis.auditor.audit_system_programs``) on the current backend and
+reports per-program contract results. With ``--pin`` it re-pins the
+``CONTRACTS.json`` op-census baseline from this run instead of comparing
+against it — the re-pinning workflow after an *intentional* lowering
+change (see README "Static analysis & program contracts").
+
+.. code-block:: console
+
+   python -m howtotrainyourmamlpytorch_tpu.cli audit
+   python -m howtotrainyourmamlpytorch_tpu.cli audit --json
+   python -m howtotrainyourmamlpytorch_tpu.cli audit --pin
+   python -m howtotrainyourmamlpytorch_tpu.cli audit --config cfg.json
+
+Without ``--config`` the audit runs the pinned *audit config* (a small
+deterministic MAML++ config with every mechanism on — the one the
+baseline is fingerprinted against). A custom ``--config`` audits that
+config's programs against the invariant contracts only: the census
+baseline is fingerprint-guarded, so shapes from another config can never
+produce phantom regressions.
+
+Exit code: 0 when every contract holds (or after a successful ``--pin``),
+1 when any program violated a contract, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import List, Optional
+
+
+def audit_config():
+    """The pinned audit config: small, deterministic, every MAML++
+    mechanism on (second order, MSL, LSLR, per-step BN), so the audited
+    programs exercise the same structure as the flagship step while
+    compiling in seconds on any backend."""
+    from ..config import MAMLConfig
+
+    return MAMLConfig(
+        dataset_name="omniglot_dataset",
+        image_height=14,
+        image_width=14,
+        image_channels=1,
+        num_classes_per_set=4,
+        num_samples_per_class=1,
+        num_target_samples=2,
+        batch_size=4,
+        cnn_num_filters=6,
+        num_stages=2,
+        max_pooling=False,
+        conv_padding=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True,
+        second_order=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        multi_step_loss_num_epochs=3,
+        total_epochs=5,
+        total_iter_per_epoch=4,
+        use_remat=False,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="audit",
+        description="Statically verify the program contracts (donation, "
+                    "no-transfer, dtype policy, op census) on the jitted "
+                    "program family",
+    )
+    parser.add_argument("--config", default=None,
+                        help="experiment JSON to audit (default: the "
+                             "pinned audit config)")
+    parser.add_argument("--contracts", default=None,
+                        help="baseline path (default: CONTRACTS.json at "
+                             "the repo root)")
+    parser.add_argument("--pin", action="store_true",
+                        help="re-pin the op-census baseline from this run "
+                             "instead of comparing against it")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from ..analysis import auditor as audit_lib
+    from ..analysis import contracts as contracts_lib
+    from ..config import MAMLConfig
+
+    if args.config:
+        cfg = MAMLConfig.from_json_file(args.config)
+    else:
+        cfg = audit_config()
+    fingerprint = contracts_lib.config_fingerprint(dataclasses.asdict(cfg))
+    baseline_path = args.contracts or contracts_lib.default_baseline_path()
+    baseline = None if args.pin else contracts_lib.load_baseline(baseline_path)
+    if baseline is not None and not contracts_lib.baseline_comparable(
+        baseline, jax_version=jax.__version__, config_fingerprint=fingerprint
+    ):
+        print(
+            "audit: pinned baseline is not comparable to this run "
+            f"(pinned jax={baseline.get('jax')} fingerprint="
+            f"{baseline.get('config_fingerprint')}, current "
+            f"jax={jax.__version__} fingerprint={fingerprint}); "
+            "op-census regression check skipped — re-pin with --pin",
+            file=sys.stderr,
+        )
+    auditor = audit_lib.ProgramAuditor(
+        cfg, baseline=baseline, config_fingerprint=fingerprint
+    )
+    reports = audit_lib.audit_system_programs(cfg, auditor=auditor)
+    violations = [v for r in reports for v in r.violations]
+
+    if args.pin:
+        data = contracts_lib.save_baseline(
+            baseline_path,
+            jax_version=jax.__version__,
+            backend=jax.default_backend(),
+            config_fingerprint=fingerprint,
+            reports=reports,
+        )
+        print(
+            f"audit: pinned {len(data['programs'])} program census(es) to "
+            f"{baseline_path} (jax {jax.__version__}, backend "
+            f"{jax.default_backend()})",
+            file=sys.stderr,
+        )
+
+    if args.json:
+        print(json.dumps(
+            {
+                "backend": jax.default_backend(),
+                "jax": jax.__version__,
+                "config_fingerprint": fingerprint,
+                "programs": {
+                    r.program: {
+                        "ok": r.ok,
+                        "violations": [
+                            {"contract": v.contract, "detail": v.detail}
+                            for v in r.violations
+                        ],
+                        "census": r.census,
+                        "donation": r.donation,
+                    }
+                    for r in reports
+                },
+            },
+            indent=2,
+            sort_keys=True,
+        ))
+    else:
+        for r in reports:
+            status = "ok" if r.ok else "FAIL"
+            alias = (r.donation or {}).get("alias_size_bytes")
+            extra = f"  alias={alias}B" if alias is not None else ""
+            print(f"{status:4s} {r.program}{extra}")
+            for v in r.violations:
+                print(f"     {v}")
+        print(
+            f"audit: {len(reports)} program(s), {len(violations)} "
+            f"contract violation(s)",
+            file=sys.stderr,
+        )
+    if args.pin:
+        return 0
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

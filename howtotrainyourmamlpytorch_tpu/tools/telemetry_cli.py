@@ -21,11 +21,12 @@ here before importing anything jax-heavy)
 * ``summary``   — run overview: record counts by kind, wall-clock span,
   epoch range, final/best validation accuracy, dispatch-timing
   percentiles, loader stream-stall stats, HBM usage, and
-  anomaly/incident/stall counts;
+  anomaly/incident/stall/retry/preemption/retrace counts;
 * ``epochs``    — the per-epoch scalar table (loss/accuracy/step-time
   columns), the epoch CSV's queryable twin;
-* ``anomalies`` — every ``anomaly`` / ``incident`` / ``watchdog_stall``
-  record, one line each (the postmortem index / anomaly timeline);
+* ``anomalies`` — every ``anomaly`` / ``incident`` / ``watchdog_stall`` /
+  ``preemption`` / ``retrace`` record, one line each (the postmortem
+  index / anomaly timeline);
 * ``tail``      — the last N records, optionally filtered by kind;
 * ``diff``      — align two runs' per-epoch scalars, report per-metric
   deltas and the first epoch where a watched metric diverges beyond
@@ -53,7 +54,8 @@ from ..telemetry.schema import iter_records, validate_file
 #: metrics `diff` watches for the divergence epoch unless --metric is given
 DEFAULT_WATCH_METRICS = ("train_loss_mean", "val_accuracy_mean")
 
-ANOMALY_KINDS = ("anomaly", "incident", "watchdog_stall", "preemption")
+ANOMALY_KINDS = ("anomaly", "incident", "watchdog_stall", "preemption",
+                 "retrace")
 
 
 def _load(path: str) -> List[dict]:
@@ -175,6 +177,10 @@ def cmd_summary(args) -> int:
         # retried through, and whether it exited on a preemption drain
         "retries": counts.get("retry", 0),
         "preemptions": counts.get("preemption", 0),
+        # static analysis (schema v4): mid-run recompiles the retrace
+        # detector caught — every one is 20-40s of TPU compile the shape
+        # discipline should have prevented
+        "retraces": counts.get("retrace", 0),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -235,6 +241,11 @@ def cmd_summary(args) -> int:
         lines.append(
             f"  resilience: {payload['retries']} I/O retries, "
             f"{payload['preemptions']} preemption exits"
+        )
+    if payload["retraces"]:
+        lines.append(
+            f"  analysis: {payload['retraces']} mid-run retrace(s) — "
+            "dispatch sites recompiled (see the anomalies timeline)"
         )
     _emit(payload, args.json, lines)
     return 0
@@ -306,6 +317,12 @@ def cmd_anomalies(args) -> int:
             lines.append(
                 f"preempt   iter {it:>8}  signal {r.get('signal')}"
                 f"  -> {r.get('checkpoint')}"
+            )
+        elif kind == "retrace":
+            lines.append(
+                f"retrace   iter {it:>8}  {r.get('site')}"
+                f"  sig={r.get('signature')}"
+                f"  n={r.get('n_signatures')}"
             )
         else:
             lines.append(

@@ -87,3 +87,51 @@ def make_synthetic_batch(cfg: MAMLConfig, batch_size=None, seed=0):
 @pytest.fixture
 def synthetic_batch():
     return make_synthetic_batch
+
+
+def make_micro_cfg(**overrides) -> MAMLConfig:
+    """The smallest config that still exercises every MAML++ mechanism
+    (second order, MSL, learnable LSLR, per-step BN) — used where many
+    programs must compile (the program-contract audits)."""
+    base = dict(
+        dataset_name="omniglot_dataset",
+        image_height=8,
+        image_width=8,
+        image_channels=1,
+        num_classes_per_set=2,
+        num_samples_per_class=1,
+        num_target_samples=1,
+        batch_size=2,
+        cnn_num_filters=4,
+        num_stages=1,
+        max_pooling=False,
+        conv_padding=True,
+        per_step_bn_statistics=True,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        use_multi_step_loss_optimization=True,
+        second_order=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        multi_step_loss_num_epochs=3,
+        total_epochs=5,
+        total_iter_per_epoch=4,
+        use_remat=False,
+    )
+    base.update(overrides)
+    return MAMLConfig(**base)
+
+
+@pytest.fixture(scope="session")
+def micro_cfg() -> MAMLConfig:
+    return make_micro_cfg()
+
+
+@pytest.fixture(scope="session")
+def audit_reports(micro_cfg):
+    """One audit of the canonical program family (4 donating train-step
+    jits + fused eval multi-step + index expander), compiled ONCE per test
+    session and shared by the contract tests (test_analysis.py) and the
+    donation-contract tests (test_donation.py)."""
+    from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
+
+    return audit_lib.audit_system_programs(micro_cfg)

@@ -1,0 +1,557 @@
+"""Program-contract auditor (analysis/auditor.py + contracts.py).
+
+Three layers of coverage:
+
+* the canonical program family audits CLEAN — donation, no-transfer,
+  dtype-policy and op-census contracts hold on all four donating
+  train-step jits, the fused eval multi-step and the index expander
+  (the session-scoped ``audit_reports`` fixture compiles the family once);
+* mutation tests — deliberately break one contract per throwaway program
+  (donation dropped, a mid-step ``device_put``, an f64 upcast, an f32
+  matmul under bf16, a census regression, a grouped-conv lowering) and
+  assert exactly that contract fires with no cross-talk;
+* the off-path — ``analysis_level='off'`` is config-only: programs built
+  under 'off' and 'strict' trace to bit-identical jaxprs, and the
+  dispatch path without a detector is a single attribute check.
+
+Plus the runtime half: RetraceDetector signature hashing, retrace events,
+strict-mode RetraceError, and the schema-v4 ``retrace`` telemetry record.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import make_micro_cfg, make_synthetic_batch
+
+from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
+from howtotrainyourmamlpytorch_tpu.analysis import contracts as contracts_lib
+from howtotrainyourmamlpytorch_tpu.analysis.auditor import (
+    ProgramAuditor,
+    RetraceDetector,
+    RetraceError,
+)
+from howtotrainyourmamlpytorch_tpu.core import maml
+
+
+def _contracts_hit(report):
+    return sorted({v.contract for v in report.violations})
+
+
+# -- the family audits clean -------------------------------------------------
+
+
+def test_family_has_expected_programs(audit_reports):
+    names = {r.program for r in audit_reports}
+    assert names == {
+        "train_step[so=1]",
+        "train_multi_step[so=1,k=2]",
+        "train_step_indexed[so=1]",
+        "train_multi_step_indexed[so=1,k=2]",
+        "eval_multi_step[k=2]",
+        "index_expander",
+    }
+
+
+def test_family_audits_clean(audit_reports):
+    for r in audit_reports:
+        assert r.ok, f"{r.program}: {[str(v) for v in r.violations]}"
+        assert r.contracts_checked == contracts_lib.CONTRACT_NAMES
+
+
+def test_family_census_nonempty(audit_reports):
+    """Every compiled program yields a census (the op classes the baseline
+    pins); the train steps are dot-dominated on the CPU im2col path."""
+    by_name = {r.program: r for r in audit_reports}
+    assert by_name["train_step[so=1]"].census.get("dot", 0) > 0
+    assert by_name["index_expander"].census.get("gather", 0) > 0
+
+
+# -- mutation tests: each contract fires alone -------------------------------
+
+
+def test_donation_contract_fires_without_donation(micro_cfg):
+    """The same train step jitted WITHOUT donate_argnums, audited against
+    the declared donation contract: only 'donation' fires (the program is
+    otherwise clean — no cross-talk)."""
+    auditor = ProgramAuditor(micro_cfg)
+    plain = jax.jit(maml.make_train_step(micro_cfg, second_order=True))
+    state = audit_lib._state_avals(micro_cfg)
+    batch = audit_lib._batch_avals(micro_cfg)
+    weights = jax.ShapeDtypeStruct(
+        (micro_cfg.number_of_training_steps_per_iter,), jnp.float32
+    )
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    report = auditor.audit(
+        "mutant_no_donation", plain, (state, *batch, weights, lr),
+        donate=maml.TRAIN_DONATE,
+    )
+    assert _contracts_hit(report) == ["donation"]
+    assert "double-buffered" in report.violations[0].detail
+
+
+def test_transfer_contract_flags_device_put(micro_cfg):
+    auditor = ProgramAuditor(micro_cfg)
+
+    def bad(x):
+        return jax.device_put(x) * 2.0
+
+    report = auditor.audit(
+        "mutant_device_put", jax.jit(bad),
+        (jax.ShapeDtypeStruct((8, 8), jnp.float32),),
+    )
+    assert _contracts_hit(report) == ["no_transfer"]
+    assert "device_put" in report.violations[0].detail
+
+
+def test_transfer_contract_flags_host_callback(micro_cfg):
+    auditor = ProgramAuditor(micro_cfg)
+
+    def bad(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a) * 2,
+            jax.ShapeDtypeStruct((8,), jnp.float32),
+            x,
+        )
+
+    report = auditor.audit(
+        "mutant_callback", jax.jit(bad),
+        (jax.ShapeDtypeStruct((8,), jnp.float32),),
+    )
+    assert _contracts_hit(report) == ["no_transfer"]
+    assert "pure_callback" in report.violations[0].detail
+
+
+def test_dtype_contract_flags_f64(micro_cfg):
+    from jax.experimental import enable_x64
+
+    auditor = ProgramAuditor(micro_cfg)
+
+    def bad(x):
+        return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+    with enable_x64():
+        report = auditor.audit(
+            "mutant_f64", jax.jit(bad),
+            (jax.ShapeDtypeStruct((8, 8), jnp.float32),),
+        )
+    assert _contracts_hit(report) == ["dtype_policy"]
+    assert "float64" in report.violations[0].detail
+
+
+def test_dtype_contract_flags_f32_matmul_under_bf16():
+    """Under compute_dtype='bfloat16' a big f32 dot is an unintended
+    upcast; scalar-sized f32 reductions (the MSL weighting dot) stay
+    legal — pinned by the clean-family test, which includes bf16-legal
+    f32 scalar dots."""
+    cfg = make_micro_cfg(compute_dtype="bfloat16")
+    auditor = ProgramAuditor(cfg)
+
+    def bad(x, w):
+        return x.astype(jnp.float32) @ w.astype(jnp.float32)
+
+    report = auditor.audit(
+        "mutant_f32_matmul", jax.jit(bad),
+        (jax.ShapeDtypeStruct((32, 32), jnp.bfloat16),
+         jax.ShapeDtypeStruct((32, 32), jnp.bfloat16)),
+    )
+    assert _contracts_hit(report) == ["dtype_policy"]
+    assert "upcast" in report.violations[0].detail
+
+    def small(x, w):
+        # scalar-loss-sized f32 contraction: legal under the policy
+        return jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))
+
+    report = auditor.audit(
+        "scalar_f32_dot", jax.jit(small),
+        (jax.ShapeDtypeStruct((4,), jnp.bfloat16),
+         jax.ShapeDtypeStruct((4,), jnp.bfloat16)),
+    )
+    assert report.ok
+
+
+def test_bf16_train_step_audits_clean():
+    """The real train step under the bf16 policy: its f32 dots are all
+    scalar-loss reductions, so the dtype contract passes."""
+    cfg = make_micro_cfg(compute_dtype="bfloat16")
+    reports = audit_lib.audit_system_programs(
+        cfg, programs=["train_step[so=1]"]
+    )
+    (report,) = reports
+    assert report.ok, [str(v) for v in report.violations]
+
+
+def test_grouped_conv_contract_fires_on_grouped_lowering(micro_cfg):
+    """A vmap-over-batched-weights lax conv lowers to a
+    feature_group_count=tasks grouped conv — the exact regression the
+    op_census contract exists to catch on the GEMM path."""
+    auditor = ProgramAuditor(micro_cfg)
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    batched = jax.vmap(conv)
+    report = auditor.audit(
+        "mutant_grouped_conv", jax.jit(batched),
+        (jax.ShapeDtypeStruct((3, 2, 8, 8, 4), jnp.float32),
+         jax.ShapeDtypeStruct((3, 3, 3, 4, 4), jnp.float32)),
+        expect_no_grouped_conv=True,
+    )
+    assert _contracts_hit(report) == ["op_census"]
+    assert "grouped" in report.violations[0].detail
+
+
+def test_census_regression_fires_and_improvement_does_not(micro_cfg):
+    """An op-census baseline with fewer interesting ops than the current
+    program flags a regression; a baseline with MORE (the current program
+    improved) stays silent."""
+    import dataclasses
+
+    fingerprint = contracts_lib.config_fingerprint(
+        dataclasses.asdict(micro_cfg)
+    )
+
+    def fake_baseline(census):
+        return {
+            "version": 1,
+            "jax": jax.__version__,
+            "backend": "cpu",
+            "config_fingerprint": fingerprint,
+            "programs": {"prog@cpu": {"census": census}},
+        }
+
+    def f(x, w):
+        return x @ w
+
+    args = (jax.ShapeDtypeStruct((16, 16), jnp.float32),
+            jax.ShapeDtypeStruct((16, 16), jnp.float32))
+    probe = ProgramAuditor(micro_cfg).audit("prog", jax.jit(f), args)
+    current = probe.census
+    smaller = {k: max(0, v - 1) for k, v in current.items()}
+    bigger = {k: v + 5 for k, v in current.items()}
+
+    regressed = ProgramAuditor(
+        micro_cfg, baseline=fake_baseline(smaller),
+        config_fingerprint=fingerprint,
+    ).audit("prog", jax.jit(f), args)
+    assert _contracts_hit(regressed) == ["op_census"]
+    assert "regression" in regressed.violations[0].detail
+
+    improved = ProgramAuditor(
+        micro_cfg, baseline=fake_baseline(bigger),
+        config_fingerprint=fingerprint,
+    ).audit("prog", jax.jit(f), args)
+    assert improved.ok
+
+
+def test_census_compare_skipped_for_foreign_baseline(micro_cfg):
+    """A baseline pinned under a different jax or audit config must never
+    produce phantom regressions — the compare disarms."""
+    baseline = {
+        "version": 1, "jax": "0.0.0", "backend": "cpu",
+        "config_fingerprint": "feedbeef00000000",
+        "programs": {"prog@cpu": {"census": {"dot": 0, "fusion": 0}}},
+    }
+    auditor = ProgramAuditor(
+        micro_cfg, baseline=baseline, config_fingerprint="something-else"
+    )
+
+    def f(x, w):
+        return x @ w
+
+    report = auditor.audit(
+        "prog", jax.jit(f),
+        (jax.ShapeDtypeStruct((16, 16), jnp.float32),
+         jax.ShapeDtypeStruct((16, 16), jnp.float32)),
+    )
+    assert report.ok
+
+
+def test_pinned_repo_baseline_loads():
+    """CONTRACTS.json at the repo root parses and covers the six canonical
+    programs (the re-pin workflow keeps it in lockstep with the family)."""
+    baseline = contracts_lib.load_baseline()
+    assert baseline is not None, "CONTRACTS.json missing at the repo root"
+    assert len(baseline["programs"]) >= 6
+    for key in baseline["programs"]:
+        assert "@" in key
+
+
+# -- analysis_level='off' leaves programs untouched --------------------------
+
+
+def test_analysis_off_programs_bit_identical():
+    """analysis_level is pure configuration: the traced train-step jaxpr
+    under 'off' and 'strict' is textually identical (the same discipline
+    as the telemetry/health off-paths)."""
+    cfg_off = make_micro_cfg(analysis_level="off")
+    cfg_strict = make_micro_cfg(analysis_level="strict")
+    state = audit_lib._state_avals(cfg_off)
+    batch = audit_lib._batch_avals(cfg_off)
+    weights = jax.ShapeDtypeStruct((2,), jnp.float32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    texts = []
+    for cfg in (cfg_off, cfg_strict):
+        step = jax.jit(
+            maml.make_train_step(cfg, second_order=True),
+            donate_argnums=maml.TRAIN_DONATE,
+        )
+        texts.append(str(step.trace(state, *batch, weights, lr).jaxpr))
+    assert texts[0] == texts[1]
+
+
+def test_analysis_off_installs_no_detector(micro_cfg):
+    """The system facade with no detector keeps dispatching normally —
+    the off-path is one attribute check."""
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    model = MAMLFewShotClassifier(micro_cfg, use_mesh=False)
+    assert model.retrace_detector is None
+    x_s, y_s, x_t, y_t = make_synthetic_batch(micro_cfg)
+    losses = model.run_train_iter((x_s, x_t, y_s, y_t), epoch=0)
+    assert np.isfinite(float(np.asarray(losses["loss"])))
+
+
+# -- runtime retrace detection -----------------------------------------------
+
+
+def test_retrace_detector_quiet_on_stable_signatures():
+    det = RetraceDetector()
+    args = (np.zeros((4, 8), np.float32), 0.01)
+    for _ in range(5):
+        assert det.observe("site_a", args) is False
+    assert det.retrace_count == 0
+
+
+def test_retrace_detector_flags_new_signature():
+    events = []
+    det = RetraceDetector(on_retrace=lambda **kw: events.append(kw))
+    det.observe("site_a", (np.zeros((4, 8), np.float32),))
+    # same shapes at another site: fine (different program)
+    det.observe("site_b", (np.zeros((2, 8), np.float32),))
+    assert det.retrace_count == 0
+    # a NEW shape at a known site is a retrace
+    assert det.observe("site_a", (np.zeros((5, 8), np.float32),)) is True
+    assert det.retrace_count == 1
+    assert events[0]["site"] == "site_a"
+    assert events[0]["n_signatures"] == 2
+    # dtype changes retrace too
+    det.observe("site_a", (np.zeros((4, 8), np.int32),))
+    assert det.retrace_count == 2
+    # re-seeing a known signature stays quiet
+    det.observe("site_a", (np.zeros((4, 8), np.float32),))
+    assert det.retrace_count == 2
+
+
+def test_retrace_detector_strict_raises():
+    det = RetraceDetector(strict=True)
+    det.observe("s", (np.zeros((4,), np.float32),))
+    with pytest.raises(RetraceError, match="retraced mid-run"):
+        det.observe("s", (np.zeros((8,), np.float32),))
+
+
+def test_retrace_event_reaches_telemetry_schema_v4(tmp_path):
+    """The on_retrace -> telemetry `retrace` record path the builder
+    wires: the emitted log validates under the v4 schema and the inspect
+    CLI surfaces the count."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import schema
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import Telemetry
+    from howtotrainyourmamlpytorch_tpu.tools import telemetry_cli
+
+    cfg = make_micro_cfg(telemetry_level="scalars")
+    tel = Telemetry(cfg, str(tmp_path))
+    det = RetraceDetector(
+        on_retrace=lambda site, signature, n_signatures: tel.event(
+            "retrace", iter=7, site=site, signature=signature,
+            n_signatures=n_signatures,
+        )
+    )
+    det.observe("train_step[so=1]", (np.zeros((4, 8), np.float32),))
+    det.observe("train_step[so=1]", (np.zeros((4, 9), np.float32),))
+    tel.close()
+    log = os.path.join(str(tmp_path), "telemetry.jsonl")
+    assert schema.validate_file(log) >= 2
+    recs = [json.loads(line) for line in open(log) if line.strip()]
+    retraces = [r for r in recs if r["kind"] == "retrace"]
+    assert len(retraces) == 1
+    assert retraces[0]["schema"] == schema.SCHEMA_VERSION
+    assert retraces[0]["site"] == "train_step[so=1]"
+    # inspect CLI: summary counts it, anomalies timeline renders a row
+    rc = telemetry_cli.main(["summary", log])
+    assert rc == 0
+    rc = telemetry_cli.main(["anomalies", log])
+    assert rc == 0
+
+
+def test_system_dispatch_observes_retrace(micro_cfg):
+    """The facade's dispatch hooks feed the detector: two train iters with
+    different target-set sizes at one site flag exactly one retrace."""
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    events = []
+    model = MAMLFewShotClassifier(micro_cfg, use_mesh=False)
+    model.retrace_detector = RetraceDetector(
+        on_retrace=lambda **kw: events.append(kw)
+    )
+    x_s, y_s, x_t, y_t = make_synthetic_batch(micro_cfg)
+    model.run_train_iter((x_s, x_t, y_s, y_t), epoch=0)
+    assert events == []
+    # same site, fatter target set -> new abstract signature -> retrace
+    x_t2 = np.concatenate([x_t, x_t], axis=2)
+    y_t2 = np.concatenate([y_t, y_t], axis=2)
+    model.run_train_iter((x_s, x_t2, y_s, y_t2), epoch=0)
+    assert len(events) == 1
+    assert events[0]["site"] == "train_step[so=1]"
+
+
+# -- builder wiring ----------------------------------------------------------
+
+
+class _BuilderShim:
+    """The slice of ExperimentBuilder state `_install_analysis` and
+    `_on_retrace` touch — exercises the real methods without a dataset."""
+
+    def __init__(self, cfg, model, telemetry):
+        self.cfg = cfg
+        self.model = model
+        self.telemetry = telemetry
+        self.flight_recorder = None
+        self.state = {"current_iter": 3}
+        self.retrace_detector = None
+        self.logged = []
+
+    def _log(self, msg):
+        self.logged.append(msg)
+
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import (
+        ExperimentBuilder as _EB,
+    )
+
+    _install_analysis = _EB._install_analysis
+    _on_retrace = _EB._on_retrace
+
+
+def _fake_reports(violations):
+    return [
+        contracts_lib.AuditReport(
+            program="train_step[so=1]",
+            backend="cpu",
+            contracts_checked=contracts_lib.CONTRACT_NAMES,
+            violations=violations,
+        )
+    ]
+
+
+def test_builder_warn_installs_detector_and_logs(monkeypatch, tmp_path):
+    """analysis_level='warn': violations are logged, the run proceeds, and
+    the retrace detector lands on the system facade."""
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import Telemetry
+
+    cfg = make_micro_cfg(
+        analysis_level="warn", telemetry_level="scalars"
+    )
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    tel = Telemetry(cfg, str(tmp_path))
+    shim = _BuilderShim(cfg, model, tel)
+    bad = [contracts_lib.ContractViolation(
+        "donation", "train_step[so=1]", "double-buffered"
+    )]
+    monkeypatch.setattr(audit_lib, "audit_system_programs",
+                        lambda *a, **k: _fake_reports(bad))
+    shim._install_analysis()
+    assert shim.retrace_detector is not None
+    assert model.retrace_detector is shim.retrace_detector
+    assert not shim.retrace_detector.strict
+    assert any("1 violation(s)" in m for m in shim.logged)
+    # the wired _on_retrace emits a schema-valid v4 record
+    shim.retrace_detector.observe("s", (np.zeros((2,), np.float32),))
+    shim.retrace_detector.observe("s", (np.zeros((3,), np.float32),))
+    tel.close()
+    from howtotrainyourmamlpytorch_tpu.telemetry import schema
+
+    log = os.path.join(str(tmp_path), "telemetry.jsonl")
+    assert schema.validate_file(log) >= 1
+    kinds = [json.loads(line)["kind"] for line in open(log) if line.strip()]
+    assert "retrace" in kinds
+
+
+def test_builder_strict_raises_on_violation(monkeypatch, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import Telemetry
+
+    cfg = make_micro_cfg(analysis_level="strict")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    shim = _BuilderShim(cfg, model, Telemetry(cfg, str(tmp_path)))
+    bad = [contracts_lib.ContractViolation(
+        "no_transfer", "train_step[so=1]", "device_put x1"
+    )]
+    monkeypatch.setattr(audit_lib, "audit_system_programs",
+                        lambda *a, **k: _fake_reports(bad))
+    with pytest.raises(contracts_lib.AuditError, match="device_put"):
+        shim._install_analysis()
+
+
+def test_builder_strict_clean_installs_strict_detector(monkeypatch, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import Telemetry
+
+    cfg = make_micro_cfg(analysis_level="strict")
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    shim = _BuilderShim(cfg, model, Telemetry(cfg, str(tmp_path)))
+    monkeypatch.setattr(audit_lib, "audit_system_programs",
+                        lambda *a, **k: _fake_reports([]))
+    shim._install_analysis()
+    assert shim.retrace_detector.strict
+    with pytest.raises(RetraceError):
+        shim.retrace_detector.observe("s", (np.zeros((2,), np.float32),))
+        shim.retrace_detector.observe("s", (np.zeros((3,), np.float32),))
+
+
+# -- cli audit ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_audit_end_to_end(tmp_path, micro_cfg, capsys):
+    """`cli audit --config ... --json` compiles the family, reports every
+    program ok, and exits 0; `--pin` writes a loadable baseline that a
+    follow-up audit compares clean against."""
+    import dataclasses
+
+    from howtotrainyourmamlpytorch_tpu.tools import audit_cli
+
+    cfg_path = tmp_path / "audit_cfg.json"
+    with open(cfg_path, "w") as f:
+        json.dump(dataclasses.asdict(micro_cfg), f)
+    contracts_path = tmp_path / "CONTRACTS.json"
+    rc = audit_cli.main([
+        "--config", str(cfg_path), "--contracts", str(contracts_path),
+        "--pin",
+    ])
+    assert rc == 0
+    pinned = contracts_lib.load_baseline(str(contracts_path))
+    assert pinned is not None and len(pinned["programs"]) == 6
+    rc = audit_cli.main([
+        "--config", str(cfg_path), "--contracts", str(contracts_path),
+        "--json",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert all(p["ok"] for p in payload["programs"].values())

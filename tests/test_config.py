@@ -181,3 +181,12 @@ def test_data_placement_validated():
         MAMLConfig(data_placement="device")
     with pytest.raises(ValueError, match="use_mmap_cache"):
         MAMLConfig(data_placement="uint8_stream")
+
+
+def test_analysis_level_validated():
+    """analysis_level is checked at config time like the other level
+    knobs: 'off'/'warn'/'strict' pass, anything else fails by name."""
+    for level in ("off", "warn", "strict"):
+        assert MAMLConfig(analysis_level=level).analysis_level == level
+    with pytest.raises(ValueError, match="analysis_level"):
+        MAMLConfig(analysis_level="paranoid")

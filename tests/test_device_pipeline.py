@@ -10,7 +10,6 @@ system facade, and the producer-thread leak fix.
 """
 
 import os
-import threading
 import time
 
 import jax

@@ -91,26 +91,43 @@ def test_donation_does_not_change_numbers(tiny_cfg, synthetic_batch):
         )
 
 
-def test_compiled_step_aliases_state_bytes(tiny_cfg, synthetic_batch):
-    """memory_analysis must show the executable aliasing at least the
-    state's byte size — the signal bench.py's ``donation`` field watches
-    for regressions (alias size collapsing => double-buffered state)."""
-    cfg = tiny_cfg
-    state = _device_state(cfg)
-    x_s, y_s, x_t, y_t = synthetic_batch(cfg)
-    w = _weights(cfg)
-    step = jax.jit(
-        maml.make_train_step(cfg, second_order=True),
-        donate_argnums=maml.TRAIN_DONATE,
+def test_all_four_train_jits_honor_donation_contract(audit_reports, micro_cfg):
+    """The alias-bytes >= state-bytes assertion, generalized into the
+    ProgramAuditor's ``donation`` contract and checked on ALL FOUR
+    train-step jits (plain / multi / indexed / multi-indexed) instead of
+    one — the signal bench.py's ``donation`` field watches for regressions
+    (alias size collapsing => double-buffered state). The session-scoped
+    ``audit_reports`` fixture compiled the family once."""
+    from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
+
+    state_bytes = audit_lib.tree_byte_size(
+        audit_lib._state_avals(micro_cfg)
     )
-    compiled = step.lower(state, x_s, y_s, x_t, y_t, w, 0.01).compile()
-    ma = compiled.memory_analysis()
-    state_bytes = sum(
-        leaf.size * leaf.dtype.itemsize
-        for leaf in jax.tree_util.tree_leaves(state)
-        if isinstance(leaf, jax.Array)
-    )
-    assert ma.alias_size_in_bytes >= state_bytes
+    assert state_bytes > 0
+    train_reports = [
+        r for r in audit_reports
+        if r.program.startswith(audit_lib.TRAIN_STEP_PROGRAMS)
+    ]
+    assert len(train_reports) == 4
+    for r in train_reports:
+        donation_violations = [
+            v for v in r.violations if v.contract == "donation"
+        ]
+        assert donation_violations == [], r.program
+        assert r.donation is not None, r.program
+        assert r.donation["donate_argnums"] == list(maml.TRAIN_DONATE)
+        assert r.donation["alias_size_bytes"] >= state_bytes, r.program
+
+
+def test_eval_programs_do_not_donate(audit_reports):
+    """Eval deliberately donates nothing (no replacement state, batches
+    unaliasable — see the contract note in core/maml.py): the audited
+    eval/expander programs carry no donation spec."""
+    for r in audit_reports:
+        if not r.program.startswith(
+            ("train_step", "train_multi_step")
+        ):
+            assert r.donation is None, r.program
 
 
 def test_system_repeated_dispatches_and_eval(tiny_cfg):

@@ -2,7 +2,6 @@
 triggers, seam wiring, and the no-spec zero-impact guarantee (identical
 jitted programs, bit-identical step metrics)."""
 
-import os
 import signal
 
 import numpy as np

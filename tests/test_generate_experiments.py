@@ -1,7 +1,6 @@
 """Experiment generator tests: the grid regenerates 36 schema-valid configs +
 launch scripts (reference script_generation_tools/, SURVEY.md §2.1)."""
 
-import json
 import os
 import subprocess
 import sys

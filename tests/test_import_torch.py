@@ -126,8 +126,6 @@ def test_converted_weights_reproduce_reference_logits(kw):
 def test_export_import_roundtrip_exact():
     """export -> import must reproduce every leaf bit-exactly (the layout
     permutations are mutual inverses)."""
-    import jax
-
     from howtotrainyourmamlpytorch_tpu.core import maml
     from howtotrainyourmamlpytorch_tpu.tools.export_torch_checkpoint import (
         convert_to_reference_state,
@@ -164,7 +162,6 @@ def test_export_import_roundtrip_exact():
 def test_exported_weights_load_into_reference_model():
     """An exported state_dict loads into the actual reference model via
     load_state_dict and reproduces OUR logits — the export-direction parity."""
-    import jax
     import torch
 
     from howtotrainyourmamlpytorch_tpu.core import maml

@@ -1,0 +1,296 @@
+"""The repo-specific JAX-pitfall linter (analysis/lint.py) — jax-free.
+
+Contract: the repo lints itself clean (every violation found during the
+lint pass's introduction was fixed or suppressed with a reason), each
+rule fires on a minimal bad fixture, reasoned suppressions silence a
+rule, and unreasoned suppressions are themselves violations (MP005).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from howtotrainyourmamlpytorch_tpu.analysis import lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "howtotrainyourmamlpytorch_tpu")
+
+
+def _write(tmp_path, rel, body):
+    """Write a fixture under a fake package tree so path-scoped rules
+    (core/, ops/, experiment/builder.py) arm."""
+    path = tmp_path / "howtotrainyourmamlpytorch_tpu" / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+# -- the repo is clean -------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    violations = lint.lint_paths(lint.default_paths())
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_default_paths_cover_package_and_bench():
+    paths = lint.default_paths()
+    assert PACKAGE in paths
+    assert os.path.join(REPO_ROOT, "bench.py") in paths
+
+
+# -- MP001: host ops in traced code ------------------------------------------
+
+
+def test_mp001_flags_numpy_in_traced_scope(tmp_path):
+    path = _write(tmp_path, "core/bad.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def make_step():
+            def step(x):
+                y = jnp.sum(x)
+                return np.asarray(y) * 2
+            return step
+    """)
+    violations = lint.lint_file(path)
+    assert [v.rule for v in violations] == ["MP001"]
+    assert "np.asarray" in violations[0].message
+
+
+def test_mp001_flags_item_and_float_and_print(tmp_path):
+    path = _write(tmp_path, "ops/bad.py", """
+        import jax.numpy as jnp
+
+        def traced(x):
+            s = jnp.mean(x)
+            print("loss", float(s))
+            return s.item()
+    """)
+    rules = [v.rule for v in lint.lint_file(path)]
+    assert rules == ["MP001", "MP001", "MP001"]
+
+
+def test_mp001_ignores_host_only_scopes(tmp_path):
+    """A scope with no jax math (loss-weight builders, LUT builders) may
+    use numpy freely; core/ host helpers stay lintable."""
+    path = _write(tmp_path, "core/host.py", """
+        import numpy as np
+
+        def loss_weights(n):
+            w = np.ones(n, dtype=np.float32) / n
+            return np.minimum(w, 1.0)
+    """)
+    assert lint.lint_file(path) == []
+
+
+def test_mp001_not_armed_outside_core_ops(tmp_path):
+    path = _write(tmp_path, "experiment/whatever.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def summarize(x):
+            return float(np.mean(np.asarray(jnp.sum(x))))
+    """)
+    assert lint.lint_file(path) == []
+
+
+# -- MP002: jit without donation at train seams ------------------------------
+
+
+def test_mp002_flags_undonated_train_jit(tmp_path):
+    path = _write(tmp_path, "experiment/bad_jit.py", """
+        import jax
+        from ..core import maml
+
+        def build(cfg):
+            return jax.jit(maml.make_train_step(cfg, True))
+    """)
+    violations = lint.lint_file(path)
+    assert [v.rule for v in violations] == ["MP002"]
+    assert "donate_argnums" in violations[0].message
+
+
+def test_mp002_accepts_donated_train_jit_and_eval_jit(tmp_path):
+    path = _write(tmp_path, "experiment/good_jit.py", """
+        import jax
+        from ..core import maml
+
+        def build(cfg):
+            train = jax.jit(
+                maml.make_train_step(cfg, True),
+                donate_argnums=maml.TRAIN_DONATE,
+            )
+            evaluate = jax.jit(maml.make_eval_step(cfg))
+            return train, evaluate
+    """)
+    assert lint.lint_file(path) == []
+
+
+# -- MP003: telemetry schema bypass ------------------------------------------
+
+
+def test_mp003_flags_handrolled_schema_record(tmp_path):
+    path = _write(tmp_path, "telemetry/bad_writer.py", """
+        import json
+
+        def emit(f, loss):
+            rec = {"schema": 4, "ts": 0.0, "kind": "epoch", "loss": loss}
+            f.write(json.dumps(rec))
+    """)
+    violations = lint.lint_file(path)
+    assert [v.rule for v in violations] == ["MP003"]
+    assert "make_record" in violations[0].message
+
+
+def test_mp003_exempts_make_record_home(tmp_path):
+    path = _write(tmp_path, "telemetry/sinks.py", """
+        def make_record(kind):
+            return {"schema": 4, "kind": kind}
+    """)
+    assert lint.lint_file(path) == []
+
+
+# -- MP004: unrouted I/O in the builder --------------------------------------
+
+
+def test_mp004_flags_direct_builder_io(tmp_path):
+    path = _write(tmp_path, "experiment/builder.py", """
+        def save(self):
+            self.model.save_model(self.dir, 1, self.state)
+            save_statistics(self.dir, ["a"])
+    """)
+    rules = [v.rule for v in lint.lint_file(path)]
+    assert rules == ["MP004", "MP004"]
+
+
+def test_mp004_accepts_retry_routed_io(tmp_path):
+    path = _write(tmp_path, "experiment/builder.py", """
+        def save(self):
+            self.retry.call(
+                lambda: self.model.save_model(self.dir, 1, self.state),
+                site="ckpt_save",
+            )
+            self._write_stats(
+                lambda: save_statistics(self.dir, ["a"]),
+                site="stats_write",
+            )
+    """)
+    assert lint.lint_file(path) == []
+
+
+# -- MP005: suppressions need reasons ----------------------------------------
+
+
+def test_reasoned_suppression_silences_rule(tmp_path):
+    path = _write(tmp_path, "core/suppressed.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def make_step():
+            def step(x):
+                y = jnp.sum(x)
+                return np.asarray(y)  # lint-ok: MP001 host fetch at trace build time, outside jit
+            return step
+    """)
+    assert lint.lint_file(path) == []
+
+
+def test_unreasoned_suppression_is_mp005(tmp_path):
+    path = _write(tmp_path, "core/unreasoned.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def make_step():
+            def step(x):
+                return np.asarray(jnp.sum(x))  # lint-ok: MP001
+            return step
+    """)
+    rules = sorted(v.rule for v in lint.lint_file(path))
+    # the suppression is rejected (MP005) AND the underlying MP001 stands
+    assert rules == ["MP001", "MP005"]
+
+
+def test_suppression_of_unknown_rule_is_mp005(tmp_path):
+    path = _write(tmp_path, "core/unknown_rule.py", """
+        X = 1  # lint-ok: MP999 not a rule
+    """)
+    rules = [v.rule for v in lint.lint_file(path)]
+    assert rules == ["MP005"]
+
+
+def test_suppression_for_wrong_rule_does_not_silence(tmp_path):
+    path = _write(tmp_path, "core/wrong_rule.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def make_step():
+            def step(x):
+                return np.asarray(jnp.sum(x))  # lint-ok: MP004 wrong rule named
+            return step
+    """)
+    rules = sorted(v.rule for v in lint.lint_file(path))
+    assert "MP001" in rules
+
+
+# -- the CLI -----------------------------------------------------------------
+
+
+def test_cli_lint_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "howtotrainyourmamlpytorch_tpu.cli", "lint"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 violation(s)" in proc.stderr
+
+
+def test_cli_lint_exits_nonzero_on_pitfall_fixture(tmp_path):
+    fixture = _write(tmp_path, "core/pitfall.py", """
+        import jax.numpy as jnp
+
+        def make_step():
+            def step(x):
+                s = jnp.mean(x)
+                print(float(s))
+                return s
+            return step
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-m", "howtotrainyourmamlpytorch_tpu.cli", "lint",
+         fixture],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "MP001" in proc.stdout
+
+
+def test_cli_lint_json_output(tmp_path):
+    fixture = _write(tmp_path, "core/pitfall.py", """
+        import jax.numpy as jnp
+
+        def make_step():
+            def step(x):
+                return jnp.mean(x).item()
+            return step
+    """)
+    import io
+    import json as json_mod
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lint.main([fixture, "--json"])
+    assert rc == 1
+    payload = json_mod.loads(buf.getvalue())
+    assert payload[0]["rule"] == "MP001"
+
+
+def test_rule_catalogue_lists_all_rules(capsys):
+    assert lint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("MP001", "MP002", "MP003", "MP004", "MP005"):
+        assert rule in out

@@ -1,7 +1,6 @@
 """Profiling/timing hooks and hybrid mesh construction."""
 
 import glob
-import os
 
 import jax
 import numpy as np

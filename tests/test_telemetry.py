@@ -548,7 +548,7 @@ def test_validate_tolerates_newer_schema_versions():
 
 
 def test_validate_file_accepts_future_schema_fixture():
-    """The pinned mixed-version fixture: v1 records, an unknown v4 kind,
+    """The pinned mixed-version fixture: v1 records, an unknown v5 kind,
     and v99 records that dropped/renamed required fields all pass — the
     forward-compatibility contract, frozen as a file so a validator
     refactor can't silently tighten it."""
@@ -579,6 +579,31 @@ def test_v3_resilience_record_kinds_validate():
         "preemption", iter=55, signal=15,
         checkpoint="saved_models/train_model_emergency",
     ))
+
+
+def test_validate_file_accepts_v3_era_fixture():
+    """The pinned v3-era log (written before the v4 `retrace` kind
+    existed) validates unchanged under the v4 validator — the backward
+    half of the version contract: v4 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v3_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 5
+
+
+def test_v4_retrace_record_kind_validates():
+    """The schema v4 addition: a `retrace` record built through the
+    sink's make_record passes strict validation, and one missing its
+    required fields is rejected."""
+    tel.validate_record(tel.make_record(
+        "retrace", iter=12, site="train_step[so=1]",
+        signature="a1b2c3d4e5f60708", n_signatures=2,
+    ))
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "retrace",
+            "iter": 12,
+        })
 
 
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------

@@ -223,3 +223,31 @@ def test_cli_inspect_dispatch_is_jax_free(tmp_path, sub):
         timeout=120,
     )
     assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_summary_and_anomalies_surface_retraces(tmp_path, capsys):
+    """Schema v4: `summary` counts retrace records (and prints the
+    analysis line), `anomalies` renders a retrace row with site and
+    signature — the inspect CLI stays jax-free."""
+    records = _run_records([0.5])
+    records.insert(-1, make_record(
+        "retrace", iter=42, site="train_step[so=1]",
+        signature="ab12cd34ef560078", n_signatures=2,
+    ))
+    log = _write_log(tmp_path / "t.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["retraces"] == 1
+    assert cli_main(["summary", log]) == 0
+    assert "1 mid-run retrace(s)" in capsys.readouterr().out
+    assert cli_main(["anomalies", log]) == 0
+    out = capsys.readouterr().out
+    assert "retrace" in out
+    assert "train_step[so=1]" in out
+    assert "ab12cd34ef560078" in out
+
+
+def test_summary_without_retraces_prints_no_analysis_line(tmp_path, capsys):
+    log = _write_log(tmp_path / "t.jsonl", _run_records([0.5]))
+    assert cli_main(["summary", log]) == 0
+    assert "mid-run retrace" not in capsys.readouterr().out
