@@ -62,16 +62,11 @@ _TPU_DEFAULTS = {
 }
 _TPU_TASKS_PER_CHIP = 12
 
-# Peak dense-matmul FLOPs/chip by (device_kind substring, dtype).  bf16 rates
-# are the published MXU peaks; fp32 runs at roughly a third of bf16 on these
-# parts (fp32 is emulated via multiple bf16 passes).
-_PEAK_FLOPS = [
-    ("v5 lite", {"bfloat16": 197e12, "float32": 66e12}),
-    ("v5e", {"bfloat16": 197e12, "float32": 66e12}),
-    ("v5p", {"bfloat16": 459e12, "float32": 153e12}),
-    ("v4", {"bfloat16": 275e12, "float32": 92e12}),
-    ("v6", {"bfloat16": 918e12, "float32": 306e12}),
-]
+# Peak dense-matmul FLOPs/chip now lives in analysis/roofline.py
+# (DEVICE_PEAKS) — ONE table shared with the static roofline/MFU model and
+# the SPMD auditor, so the MFU this bench quotes and the MFU the roofline
+# predicts can never disagree about what "peak" means. Imported in main()
+# next to the other analysis helpers.
 
 
 def _probe_backend() -> None:
@@ -148,11 +143,11 @@ def train_flops_per_task(cfg, second_order: bool = True) -> float:
 
 
 def _peak_flops(device_kind: str, dtype: str) -> float | None:
-    kind = device_kind.lower()
-    for key, table in _PEAK_FLOPS:
-        if key in kind:
-            return table.get(dtype, table["float32"])
-    return None
+    """Published peak FLOPs/s for the quoted MFU — None for unknown
+    hardware and for the roofline table's nominal (CPU) entries."""
+    from howtotrainyourmamlpytorch_tpu.analysis.roofline import peak_flops
+
+    return peak_flops(device_kind, dtype)
 
 
 def _devices_or_cpu():
@@ -539,6 +534,9 @@ def main() -> None:
         donation_stats,
         hlo_cost_breakdown,
     )
+    from howtotrainyourmamlpytorch_tpu.analysis.roofline import (
+        roofline_report,
+    )
     from howtotrainyourmamlpytorch_tpu.core import maml, msl
     overrides = {}
     for key in ("batch_size", "cnn_num_filters", "image_height", "image_width",
@@ -617,6 +615,7 @@ def main() -> None:
     xla_flops_per_batch = None
     hlo_cost = None
     donation = None
+    compiled = None
     try:
         compiled = step.lower(
             state, x_s, y_s, x_t, y_t, weights, 1e-3
@@ -723,6 +722,28 @@ def main() -> None:
         else None
     )
 
+    # static roofline model of the exact executable the loop timed
+    # (analysis/roofline.py): compute- vs memory-bound, predicted MFU/HFU
+    # from the same cost-analysis counts, and the ranked decomposition of
+    # predicted time into HLO opcode contributors — the roofline's
+    # flops_per_task and the xla_flops_per_task above derive from the same
+    # surface, so the audit's cross-check can hold them to each other
+    roofline = None
+    if compiled is not None:
+        try:
+            roofline = roofline_report(
+                compiled,
+                device_kind=device_kind,
+                dtype=cfg.compute_dtype,
+                tasks=max(1, int(tasks_per_executable)),
+                model_flops=(
+                    train_flops_per_task(cfg) * tasks_per_executable
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 - informational metric only
+            print(f"bench: roofline model unavailable ({e!r})",
+                  file=sys.stderr)
+
     result = {
         "metric": "meta_tasks_per_sec_per_chip",
         "value": round(tasks_per_sec, 3),
@@ -753,6 +774,10 @@ def main() -> None:
         # regression is visible here before it shows in throughput)
         "hlo_cost": hlo_cost,
         "donation": donation,
+        # the static roofline/MFU model of the timed executable
+        # (informational — a lowering that shifts the program across the
+        # roofline shows up here before it shows in throughput)
+        "roofline": roofline,
         # the serial tail between epochs: fused-val + checkpoint seconds
         # (informational — not part of baseline comparability)
         "epoch_boundary": epoch_boundary,
@@ -821,7 +846,8 @@ def main() -> None:
             if k not in ("vs_baseline", "baseline_backend",
                          "baseline_refreshed", "epoch_boundary",
                          "input_pipeline", "telemetry_overhead",
-                         "health_overhead", "hlo_cost", "donation")
+                         "health_overhead", "hlo_cost", "donation",
+                         "roofline")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

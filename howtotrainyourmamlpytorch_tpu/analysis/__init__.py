@@ -1,22 +1,32 @@
 """Static analysis: program-contract audits + a repo-specific lint pass.
 
-Two layers with different import weights:
+The layers, by import weight:
 
 * :mod:`analysis.contracts` (stdlib-only) — the contract vocabulary
   (``ContractViolation`` / ``AuditError``), the optimized-HLO op-census
-  helpers shared with ``bench.py``, and the pinned ``CONTRACTS.json``
-  baseline format;
+  AND collective-census helpers shared with ``bench.py``, and the pinned
+  ``CONTRACTS.json`` baseline format (single-device ``program@backend``
+  keys plus mesh-keyed ``program@backend@RxC`` SPMD entries);
+* :mod:`analysis.roofline` (stdlib-only) — the static roofline/MFU model:
+  the device-peak table (also bench.py's MFU denominator), the roofline
+  prediction per compiled program, and the ranked decomposition of
+  predicted time into HLO opcode contributors;
 * :mod:`analysis.auditor` (imports jax) — ``ProgramAuditor`` verifies the
-  contracts against the jaxpr and compiled HLO of every jitted program the
-  system builds, and ``RetraceDetector`` watches abstract dispatch
-  signatures at runtime for mid-run retraces;
+  single-device contracts against the jaxpr and compiled HLO of every
+  jitted program the system builds, and ``RetraceDetector`` watches
+  abstract dispatch signatures at runtime for mid-run retraces;
+* :mod:`analysis.spmd` (imports jax) — ``SpmdAuditor`` compiles the same
+  program family under a real ``(data, task)`` mesh and verifies the SPMD
+  performance contracts: sharding, per-axis collective census, static
+  per-device HBM budget, roofline;
 * :mod:`analysis.lint` (stdlib-only, AST-based) — repo-specific
   traced-code pitfall checkers, runnable on a machine without jax.
 
 ``cfg.analysis_level`` gates everything: ``'off'`` (default) installs
 nothing and the jitted programs are bit-identical to a pre-analysis build
-(tested); ``'warn'`` audits at program-build time and reports retraces to
-telemetry; ``'strict'`` fails the run on any violation or retrace.
+(tested); ``'warn'`` audits at program-build time (adding the SPMD audit
+on multi-device single-host builds) and reports retraces to telemetry;
+``'strict'`` fails the run on any violation or retrace.
 """
 
 from .contracts import AuditError, ContractViolation  # noqa: F401

@@ -38,6 +38,18 @@ from typing import Dict, List, Optional, Tuple
 #: the contract names, in reporting order
 CONTRACT_NAMES = ("donation", "no_transfer", "dtype_policy", "op_census")
 
+#: the SPMD performance contracts (analysis/spmd.py), in reporting order:
+#: ``sharding`` — batch args sharded over (data, task), state/stores
+#: replicated in AND out; ``collective_census`` — the per-axis collective
+#: op/byte census must not regress vs the mesh-keyed baseline, and no
+#: collective may carry uint8 (pixel-store) data or store-sized volumes;
+#: ``hbm_budget`` — the static per-device memory_analysis peak plus the
+#: resident-store expectation must fit ``hbm_budget_gb``; ``roofline`` —
+#: the static roofline/MFU model's device-peak entry and flops cross-check
+#: (analysis/roofline.py) must hold.
+SPMD_CONTRACT_NAMES = ("sharding", "collective_census", "hbm_budget",
+                       "roofline")
+
 #: op classes that distinguish a healthy lowering from a regressed one —
 #: the census the baseline pins and the regression check compares (the full
 #: census would drown the signal in elementwise noise). Shared with
@@ -56,6 +68,21 @@ HLO_SCALAR_KEYS = ("flops", "transcendentals", "bytes accessed",
 #: host-transfer forms; within-device collectives are not in this list)
 HOST_TRANSFER_HLO_OPS = ("infeed", "outfeed", "send", "recv",
                          "send-done", "recv-done")
+
+#: HLO opcodes that are cross-device collectives — the ops the SPMD
+#: collective census counts and the mesh-keyed baseline pins (the ``-start``
+#: async forms are folded into their base opcode by the census)
+HLO_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                      "collective-permute", "all-to-all")
+
+#: HLO element-type prefix -> bytes per element (the types this codebase
+#: can emit; unknown prefixes are counted as 4 bytes with no error — the
+#: census must never crash on exotic HLO)
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
 
 
 @dataclass(frozen=True)
@@ -95,6 +122,20 @@ class AuditReport:
     @property
     def ok(self) -> bool:
         return not self.violations
+
+
+@dataclass
+class SpmdAuditReport(AuditReport):
+    """An SPMD audit's findings: the base report plus the per-axis
+    collective census, the static per-device HBM figures and the roofline
+    model (analysis/roofline.py) of the compiled sharded program."""
+
+    mesh_spec: str = ""
+    collectives: Dict[str, Dict[str, Dict[str, int]]] = field(
+        default_factory=dict
+    )
+    hbm: Optional[Dict[str, float]] = None
+    roofline: Optional[dict] = None
 
 
 # -- optimized-HLO text analysis ---------------------------------------------
@@ -137,6 +178,207 @@ def host_transfer_ops(hlo_text: str) -> Dict[str, int]:
 def f64_shape_count(hlo_text: str) -> int:
     """Occurrences of an ``f64[...]`` shape anywhere in the HLO text."""
     return len(re.findall(r"\bf64\[", hlo_text))
+
+
+# -- SPMD collective census (analysis/spmd.py drives this) --------------------
+
+_SHAPE_RE = re.compile(r"(pred|[a-z]+\d+)\[([0-9,]*)\]")
+
+#: one HLO instruction: `%name = <shape-or-tuple> <opcode>(...)`
+_COLLECTIVE_INSN_RE = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+("
+    + "|".join(HLO_COLLECTIVE_OPS)
+    + r")(-start)?\(([^)]*)\)([^\n]*)"
+)
+
+#: iota replica groups: `[2,4]<=[8]` or `[4,2]<=[2,4]T(1,0)`
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+#: explicit replica groups: `replica_groups={{0,1},{2,3}}`
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})\}")
+
+
+def hlo_shape_bytes(shape_str: str) -> int:
+    """Bytes of an HLO shape string — `f32[8,4]`, a tuple `(f32[2], u8[4])`,
+    or anything containing such shapes (layout suffixes ignored). Scalar
+    shapes (`f32[]`) count one element."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_str):
+        dtype, dims = m.group(1), m.group(2)
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        total += n * _HLO_DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def _parse_iota_groups(group_dims, iota_dims, perm) -> List[List[int]]:
+    """Expand the iota replica-group form into explicit id lists: ids
+    0..prod(iota_dims)-1 reshaped to ``iota_dims``, transposed by ``perm``,
+    flattened, then split into ``group_dims[0]`` groups of
+    prod(group_dims[1:]) members (V2 iota tile assignment semantics)."""
+    n = 1
+    for d in iota_dims:
+        n *= d
+    ids = list(range(n))
+    if perm and perm != list(range(len(iota_dims))):
+        # transpose: position in the permuted array -> original id
+        strides = [0] * len(iota_dims)
+        acc = 1
+        for i in range(len(iota_dims) - 1, -1, -1):
+            strides[i] = acc
+            acc *= iota_dims[i]
+        new_dims = [iota_dims[p] for p in perm]
+        new_strides = [strides[p] for p in perm]
+        out = []
+        idx = [0] * len(new_dims)
+        for _ in range(n):
+            out.append(sum(i * s for i, s in zip(idx, new_strides)))
+            for axis in range(len(new_dims) - 1, -1, -1):
+                idx[axis] += 1
+                if idx[axis] < new_dims[axis]:
+                    break
+                idx[axis] = 0
+        ids = out
+    group_size = 1
+    for d in group_dims[1:]:
+        group_size *= d
+    group_size = max(1, group_size)
+    return [ids[i:i + group_size] for i in range(0, len(ids), group_size)]
+
+
+def parse_replica_groups(insn_tail: str) -> Optional[List[List[int]]]:
+    """Replica groups of one collective instruction's trailing attributes,
+    as explicit device-id lists; None when absent/unparseable (the census
+    then classifies the collective as 'unknown' instead of guessing)."""
+    m = _IOTA_GROUPS_RE.search(insn_tail)
+    if m:
+        group_dims = [int(d) for d in m.group(1).split(",")]
+        iota_dims = [int(d) for d in m.group(2).split(",")]
+        perm = [int(d) for d in m.group(3).split(",")] if m.group(3) else None
+        return _parse_iota_groups(group_dims, iota_dims, perm)
+    m = _EXPLICIT_GROUPS_RE.search(insn_tail)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(x) for x in grp.replace(" ", "").split(",") if x]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    return None
+
+
+def classify_replica_groups(
+    groups: Optional[List[List[int]]], rows: int, cols: int
+) -> str:
+    """Which mesh axis a collective's replica groups span, for a (rows,
+    cols) = (data/DCN, task/ICI) mesh whose devices are laid out row-major
+    (device d sits at (d // cols, d % cols) — how ``hybrid_task_mesh``
+    builds its grid and how the partitioner numbers them):
+
+    * ``'ici'``  — every group stays within one mesh row (task axis);
+    * ``'dcn'``  — every group stays within one mesh column (data axis);
+    * ``'both'`` — some group spans rows AND columns (a global reduce);
+    * ``'unknown'`` — groups missing/unparseable.
+
+    Degenerate single-row meshes (1xN) classify as 'ici', single-column
+    (Nx1) as 'dcn'.
+    """
+    if not groups:
+        return "unknown"
+    span_rows = False
+    span_cols = False
+    for g in groups:
+        if len(g) < 2:
+            continue
+        if len({d // cols for d in g}) > 1:
+            span_rows = True
+        if len({d % cols for d in g}) > 1:
+            span_cols = True
+    if span_rows and span_cols:
+        return "both"
+    if span_rows:
+        return "dcn"
+    if span_cols:
+        return "ici"
+    return "ici" if rows == 1 else ("dcn" if cols == 1 else "unknown")
+
+
+def collective_instructions(hlo_text: str) -> List[dict]:
+    """Every collective instruction in an optimized-HLO dump:
+    ``{"op", "bytes", "shape", "groups"}`` — bytes is the instruction's
+    output volume (what actually crosses the interconnect, up to the
+    reduction factor), groups the parsed replica groups (or None).
+
+    Async ``-start`` forms (TPU optimized HLO emits start/done pairs) are
+    folded into their base opcode, and their tuple shape — which aliases
+    the operand(s) alongside the result(s) — is charged only its result
+    half, not double. The ``-done`` op consumes the start's tuple and is
+    not matched at all.
+    """
+    out = []
+    for m in _COLLECTIVE_INSN_RE.finditer(hlo_text):
+        shape, op, is_start, _operands, tail = m.groups()
+        if is_start and shape.startswith("("):
+            # (operands..., results...): the second half is what lands
+            parts = _SHAPE_RE.findall(shape)
+            results = parts[len(parts) // 2:]
+            nbytes = sum(
+                hlo_shape_bytes(f"{dtype}[{dims}]")
+                for dtype, dims in results
+            )
+        else:
+            nbytes = hlo_shape_bytes(shape)
+        out.append({
+            "op": op,
+            "bytes": nbytes,
+            "shape": shape if "(" not in shape else shape[:120],
+            "groups": parse_replica_groups(tail),
+        })
+    return out
+
+
+def collective_census(
+    hlo_text: str, rows: int, cols: int
+) -> Dict[str, Dict[str, Dict[str, int]]]:
+    """The SPMD collective census: per collective opcode, per mesh-axis
+    class (``ici`` / ``dcn`` / ``both`` / ``unknown``), instruction count
+    and total output bytes — the figure the mesh-keyed baseline pins and
+    ``compare_collective_census`` guards."""
+    census: Dict[str, Dict[str, Dict[str, int]]] = {}
+    for insn in collective_instructions(hlo_text):
+        axis = classify_replica_groups(insn["groups"], rows, cols)
+        slot = census.setdefault(insn["op"], {}).setdefault(
+            axis, {"count": 0, "bytes": 0}
+        )
+        slot["count"] += 1
+        slot["bytes"] += insn["bytes"]
+    return census
+
+
+def compare_collective_census(
+    current: Dict[str, Dict[str, Dict[str, int]]],
+    pinned: Dict[str, Dict[str, Dict[str, int]]],
+) -> List[str]:
+    """Regressions of the current collective census vs the pinned one: any
+    (op, axis) whose count or byte volume GREW, or that appeared where the
+    baseline had none. Shrinkage is an improvement — reported by ``cli
+    audit`` as a re-pin suggestion, never a violation (same semantics as
+    ``compare_census``)."""
+    regressions = []
+    for op in sorted(current):
+        for axis in sorted(current[op]):
+            now = current[op][axis]
+            then = (pinned.get(op) or {}).get(axis) or {"count": 0, "bytes": 0}
+            for key in ("count", "bytes"):
+                if int(now.get(key, 0)) > int(then.get(key, 0)):
+                    regressions.append(
+                        f"{op}@{axis} {key}: {int(then.get(key, 0))} -> "
+                        f"{int(now.get(key, 0))}"
+                    )
+    return regressions
 
 
 # -- compiled-executable helpers (shared with bench.py) ----------------------
@@ -231,6 +473,13 @@ def census_key(program: str, backend: str) -> str:
     return f"{program}@{backend}"
 
 
+def spmd_census_key(program: str, backend: str, mesh_spec: str) -> str:
+    """Mesh-keyed baseline key (``train_step[so=1]@cpu@1x8``): the same
+    program compiles to different collectives per mesh shape, so SPMD
+    entries pin per ``program@backend@mesh``."""
+    return f"{program}@{backend}@{mesh_spec}"
+
+
 def load_baseline(path: Optional[str] = None) -> Optional[dict]:
     """Parse a pinned baseline, or None when absent/unreadable (the
     regression check degrades to the invariant constraints only)."""
@@ -247,25 +496,45 @@ def load_baseline(path: Optional[str] = None) -> Optional[dict]:
 
 def save_baseline(path: str, *, jax_version: str, backend: str,
                   config_fingerprint: str,
-                  reports: List[AuditReport]) -> dict:
+                  reports: List[AuditReport],
+                  mesh_spec: Optional[str] = None) -> dict:
     """Re-pin the baseline from a set of audit reports (``cli audit
     --pin``). The jax version and config fingerprint are recorded so a
     later compare against a different toolchain or audit config skips
-    with a note instead of producing phantom regressions."""
+    with a note instead of producing phantom regressions.
+
+    ``mesh_spec`` keys the entries per mesh (``program@backend@RxC``) and
+    records any per-report collective census. When the on-disk baseline
+    was pinned under the SAME jax/backend/fingerprint, entries for OTHER
+    keys are preserved — so ``cli audit --pin`` and ``cli audit --mesh 1x8
+    --pin`` compose instead of clobbering each other's programs; a
+    foreign baseline is replaced outright."""
+    prior = load_baseline(path)
+    programs: Dict[str, dict] = {}
+    if prior is not None and baseline_comparable(
+        prior, jax_version=jax_version, config_fingerprint=config_fingerprint
+    ) and prior.get("backend") == backend:
+        programs.update(prior.get("programs", {}))
+    for r in reports:
+        key = (
+            spmd_census_key(r.program, r.backend, mesh_spec)
+            if mesh_spec
+            else census_key(r.program, r.backend)
+        )
+        entry: Dict[str, object] = {
+            "census": dict(r.census),
+            "alias_size_bytes": ((r.donation or {}).get("alias_size_bytes")),
+        }
+        collectives = getattr(r, "collectives", None)
+        if collectives is not None:
+            entry["collectives"] = collectives
+        programs[key] = entry
     data = {
         "version": BASELINE_VERSION,
         "jax": jax_version,
         "backend": backend,
         "config_fingerprint": config_fingerprint,
-        "programs": {
-            census_key(r.program, r.backend): {
-                "census": dict(r.census),
-                "alias_size_bytes": (
-                    (r.donation or {}).get("alias_size_bytes")
-                ),
-            }
-            for r in reports
-        },
+        "programs": programs,
     }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

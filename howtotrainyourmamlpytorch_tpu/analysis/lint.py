@@ -24,7 +24,15 @@ traced, which must donate, which I/O must retry:
   filesystem fault into a dead run;
 * **MP005** — a suppression comment without a reason (suppressions are
   ``# lint-ok: MPnnn <reason>`` on the offending line; the reason is
-  mandatory and the rule id must exist).
+  mandatory and the rule id must exist);
+* **MP006** — a non-owning numpy view over foreign-owned memory:
+  ``np.frombuffer(...)`` anywhere (always ``owndata=False`` over a buffer
+  something else may free), and ``np.asarray(...)`` / ``np.asanyarray``
+  inside ``experiment/checkpoint.py`` (the restore seam — PR 6's
+  owndata=False corruption class: numpy views over tensorstore-owned
+  capsules that die with the restore context). The owning spelling is
+  ``np.array(...)`` (or ``.copy()``); a justified view carries a reasoned
+  ``# lint-ok: MP006`` suppression.
 
 Run via ``python -m howtotrainyourmamlpytorch_tpu.cli lint [paths...]``
 (defaults to the package + ``bench.py``); exits nonzero on violations.
@@ -48,6 +56,9 @@ RULES: Dict[str, str] = {
     "MP003": "telemetry record constructed outside schema's make_record",
     "MP004": "checkpoint/stats I/O not routed through resilience.retry",
     "MP005": "lint suppression without a reason",
+    "MP006": "non-owning numpy view over restored/foreign memory "
+             "(np.frombuffer, or np.asarray in the checkpoint restore "
+             "seam) — use an owning np.array copy",
 }
 
 #: builtins whose call inside a traced scope forces a host sync or bakes a
@@ -268,6 +279,72 @@ def _check_unrouted_io(path: str, tree: ast.Module) -> List[Violation]:
     return out
 
 
+def _check_view_over_foreign_memory(
+    path: str, tree: ast.Module, restore_seam: bool
+) -> List[Violation]:
+    """MP006 — numpy views that do not own their memory.
+
+    ``np.frombuffer`` is flagged everywhere: its result is always a view
+    (``owndata=False``) over a buffer whose lifetime something else
+    controls — the exact class of the PR 6 checkpoint-corruption bugs.
+    In the checkpoint restore seam (``restore_seam=True``), ``np.asarray``
+    / ``np.asanyarray`` are flagged too: over a freshly-restored
+    tensorstore/orbax leaf they alias memory that dies with the restore
+    context; the owning spelling there is ``np.array``. A call whose
+    result is immediately copied (``np.frombuffer(...).copy()`` or
+    wrapped in ``np.array(...)``) is an explicit owning copy and passes.
+    """
+    np_aliases = _numpy_aliases(tree)
+    out: List[Violation] = []
+
+    def flagged_call(node: ast.Call) -> Optional[str]:
+        func = node.func
+        chain = _attr_chain(func) if isinstance(
+            func, (ast.Attribute, ast.Name)
+        ) else ""
+        if chain.split(".")[0] not in np_aliases or "." not in chain:
+            return None
+        attr = chain.split(".")[-1]
+        if attr == "frombuffer":
+            return chain
+        if restore_seam and attr in ("asarray", "asanyarray"):
+            return chain
+        return None
+
+    def owned(parent: ast.AST, node: ast.Call) -> bool:
+        # np.array(np.frombuffer(...)) or np.frombuffer(...).copy(): the
+        # view never escapes un-owned
+        if isinstance(parent, ast.Attribute) and parent.attr == "copy":
+            return True
+        if isinstance(parent, ast.Call):
+            chain = _attr_chain(parent.func) if isinstance(
+                parent.func, (ast.Attribute, ast.Name)
+            ) else ""
+            if chain.split(".")[-1] == "array" and (
+                chain.split(".")[0] in np_aliases
+            ):
+                return True
+        return False
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                chain = flagged_call(child)
+                if chain is not None and not owned(node, child):
+                    out.append(Violation(
+                        path, child.lineno, "MP006",
+                        f"{chain}() returns a non-owning view over memory "
+                        "something else may free (the PR 6 owndata=False "
+                        "checkpoint-corruption class); copy it with "
+                        "np.array(...) or .copy() while the source is "
+                        "alive",
+                    ))
+            visit(child)
+
+    visit(tree)
+    return out
+
+
 def _apply_suppressions(
     violations: List[Violation], path: str, source_lines: List[str]
 ) -> List[Violation]:
@@ -328,6 +405,9 @@ def lint_file(path: str) -> List[Violation]:
         violations += _check_schema_bypass(path, tree)
     if rel == "experiment/builder.py":
         violations += _check_unrouted_io(path, tree)
+    violations += _check_view_over_foreign_memory(
+        path, tree, restore_seam=(rel == "experiment/checkpoint.py")
+    )
     return _apply_suppressions(violations, path, source.splitlines())
 
 

@@ -1,0 +1,385 @@
+"""Static roofline/MFU model of a compiled program.
+
+BENCH_BASELINE.json pins the flagship second-order step at 2.5% MFU on
+TPU v5 lite and ROADMAP item 2 says "close the gap" — but the bench line
+only states the number; nothing explains *where the other 97.5% goes*.
+This module turns the already-available static surfaces (XLA's
+``cost_analysis`` flops + bytes accessed, the optimized-HLO op census,
+and a small device-peak table) into a roofline model per program:
+
+* which side of the roofline the program sits on (compute- vs
+  memory-bound: arithmetic intensity ``flops / bytes`` against the
+  device's critical intensity ``peak_flops / hbm_bw``);
+* the predicted step time, HFU and — when the analytic model-flop count
+  is supplied — MFU implied by the static counts alone;
+* a ranked decomposition of that predicted time into the top-k HLO
+  opcode contributors (dot/conv flops are recovered per instruction from
+  the HLO text; everything else is charged its memory traffic), so "the
+  MFU is low" becomes "fusions move 4x the bytes the dots do" — a work
+  list, not a mystery.
+
+The model is *static*: no execution, no profiler — it runs at audit time
+(``cli audit --mesh``), at build time (``analysis_level != 'off'``) and
+inside ``bench.py`` (the ``roofline`` field), and its flops/task is
+cross-checked against the ``xla_flops_per_task`` the bench records (both
+derive from the same ``cost_analysis`` surface, so a disagreement means
+the model is reading a different executable than the bench timed).
+
+Deliberately stdlib-only, like :mod:`analysis.contracts`: ``bench.py``
+imports the device-peak table from here (ONE peak table — the MFU the
+bench quotes and the MFU the roofline predicts can never disagree about
+what "peak" means), and jax-free tooling can rank an HLO dump scp'd off
+a pod.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from .contracts import ContractViolation, cost_analysis_dict, hlo_shape_bytes
+
+#: Peak dense-matmul FLOPs/chip and HBM bandwidth by device kind. bf16
+#: rates are the published MXU peaks; fp32 runs at roughly a third of
+#: bf16 on these parts (emulated via multiple bf16 passes). ``nominal``
+#: entries (the CPU fallback) let the roofline model run anywhere —
+#: bench.py's quoted MFU ignores them (a made-up CPU "peak" would turn
+#: the longitudinal MFU series into noise).
+DEVICE_PEAKS: List[dict] = [
+    {"kind": "v5 lite", "flops": {"bfloat16": 197e12, "float32": 66e12},
+     "hbm_bytes_per_s": 819e9, "nominal": False},
+    {"kind": "v5e", "flops": {"bfloat16": 197e12, "float32": 66e12},
+     "hbm_bytes_per_s": 819e9, "nominal": False},
+    {"kind": "v5p", "flops": {"bfloat16": 459e12, "float32": 153e12},
+     "hbm_bytes_per_s": 2765e9, "nominal": False},
+    {"kind": "v4", "flops": {"bfloat16": 275e12, "float32": 92e12},
+     "hbm_bytes_per_s": 1228e9, "nominal": False},
+    {"kind": "v6", "flops": {"bfloat16": 918e12, "float32": 306e12},
+     "hbm_bytes_per_s": 1638e9, "nominal": False},
+    # CPU hosts: a nominal single-core figure so the model (and its CI
+    # tests) produce a full report on the 8-virtual-device test backend
+    {"kind": "cpu", "flops": {"bfloat16": 1e11, "float32": 1e11},
+     "hbm_bytes_per_s": 5e10, "nominal": True},
+]
+
+#: contributors reported by the decomposition
+TOP_K_CONTRIBUTORS = 5
+
+
+def find_peak_entry(
+    device_kind: str, peaks: Optional[List[dict]] = None
+) -> Optional[dict]:
+    """The peak-table entry whose ``kind`` substring matches
+    ``device_kind`` (case-insensitive), or None."""
+    kind = (device_kind or "").lower()
+    for entry in peaks if peaks is not None else DEVICE_PEAKS:
+        if entry.get("kind", "") in kind:
+            return entry
+    return None
+
+
+def peak_flops(
+    device_kind: str, dtype: str, peaks: Optional[List[dict]] = None
+) -> Optional[float]:
+    """Published peak FLOPs/s for (device kind, compute dtype) — None for
+    unknown hardware AND for nominal (CPU-fallback) entries: this is the
+    denominator of the MFU the bench *quotes*, which must never be a
+    made-up number."""
+    entry = find_peak_entry(device_kind, peaks)
+    if entry is None or entry.get("nominal"):
+        return None
+    table = entry.get("flops") or {}
+    value = table.get(dtype, table.get("float32"))
+    return float(value) if value else None
+
+
+# -- per-instruction flop/byte recovery from the optimized HLO ----------------
+
+#: `%name = <shape> <opcode>(<operands>)<attributes>`
+_INSN_RE = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+([a-z][a-z0-9-]*)\(([^\n]*)"
+)
+_DIMS_RE = re.compile(r"\{([0-9,]*)\}")
+_OPERAND_SHAPE_RE = re.compile(r"(?:pred|[a-z]+\d+)\[[0-9,]*\]")
+_WINDOW_SIZE_RE = re.compile(r"window=\{[^}]*size=([0-9x]+)")
+_DIM_LABELS_RE = re.compile(r"dim_labels=(\S+?)_(\S+?)->")
+
+
+def _shape_elems(shape_str: str) -> int:
+    m = re.search(r"\[([0-9,]*)\]", shape_str)
+    if not m:
+        return 1
+    n = 1
+    for d in m.group(1).split(","):
+        if d.strip():
+            n *= int(d)
+    return n
+
+
+def _shape_dims(shape_str: str) -> List[int]:
+    m = re.search(r"\[([0-9,]*)\]", shape_str)
+    if not m:
+        return []
+    return [int(d) for d in m.group(1).split(",") if d.strip()]
+
+
+def _dot_flops(out_shape: str, operands: str, tail: str) -> float:
+    """2 * out_elems * K for one HLO ``dot``: K from the lhs operand's
+    contracting dims (printed inline in the instruction)."""
+    shapes = _OPERAND_SHAPE_RE.findall(operands)
+    m = re.search(r"lhs_contracting_dims=\{([0-9,]*)\}", tail)
+    if not shapes or not m:
+        return 0.0
+    lhs_dims = _shape_dims(shapes[0])
+    k = 1
+    for idx in (int(d) for d in m.group(1).split(",") if d.strip()):
+        if idx < len(lhs_dims):
+            k *= lhs_dims[idx]
+    return 2.0 * _shape_elems(out_shape) * k
+
+
+def _conv_flops(out_shape: str, operands: str, tail: str) -> float:
+    """2 * out_elems * kernel_spatial * cin_per_group for one HLO
+    ``convolution`` (dim_labels names the rhs input-feature dim)."""
+    shapes = _OPERAND_SHAPE_RE.findall(operands)
+    win = _WINDOW_SIZE_RE.search(tail)
+    labels = _DIM_LABELS_RE.search(tail)
+    if len(shapes) < 2 or win is None:
+        return 0.0
+    spatial = 1
+    for d in win.group(1).split("x"):
+        spatial *= int(d)
+    rhs_dims = _shape_dims(shapes[1])
+    cin = 1
+    if labels is not None and "i" in labels.group(2):
+        i_pos = labels.group(2).index("i")
+        if i_pos < len(rhs_dims):
+            cin = rhs_dims[i_pos]
+    elif rhs_dims:
+        cin = rhs_dims[-2] if len(rhs_dims) >= 2 else rhs_dims[0]
+    return 2.0 * _shape_elems(out_shape) * spatial * cin
+
+
+#: opcodes that move no bytes and do no math — pure aliasing/bookkeeping,
+#: excluded from the decomposition so the ranking names real work
+_FREE_OPS = frozenset({"bitcast", "tuple", "get-tuple-element",
+                       "after-all", "partition-id", "replica-id"})
+
+#: elementwise arithmetic opcodes charged ~1 flop per output element (the
+#: XLA cost analysis counts these too — without them the decomposition's
+#: flop coverage collapses on elementwise-heavy programs)
+_ELEMENTWISE_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "power",
+    "exponential", "log", "rsqrt", "sqrt", "tanh", "negate", "compare",
+    "select", "and", "or", "xor", "fusion",
+})
+
+
+def _strip_fused_computation_bodies(hlo_text: str) -> str:
+    """Drop the instruction lines inside ``%fused_computation`` blocks.
+
+    A fusion's *internals* live in registers — charging each internal
+    add/multiply its full output bytes would count as HBM traffic exactly
+    the bytes fusion exists to keep out of HBM, and double-count the work
+    the enclosing ``fusion`` instruction is already charged for.
+    Computation headers sit at column 0 in the HLO dump; everything until
+    the closing ``}`` of a fused computation is skipped. Other non-entry
+    computations (while bodies, reduction regions) are kept: their ops
+    run for real."""
+    out = []
+    in_fused = False
+    for line in hlo_text.splitlines():
+        if line and not line[0].isspace() and line.rstrip().endswith("{"):
+            in_fused = line.lstrip().startswith("%fused_computation")
+            continue
+        if line.strip() == "}":
+            in_fused = False
+            continue
+        if not in_fused:
+            out.append(line)
+    return "\n".join(out)
+
+
+def op_cost_census(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Per-opcode static costs over an optimized-HLO dump:
+    ``{op: {count, flops, bytes}}``. Flops are recovered per instruction
+    for dot/convolution (the ops that carry the model's real compute) and
+    estimated at one per output element for elementwise arithmetic;
+    every opcode is charged its output bytes as memory traffic (fusion
+    bodies excluded — see ``_strip_fused_computation_bodies``). The dot
+    of this census with the device-peak table is the decomposition
+    ``roofline_report`` ranks."""
+    census: Dict[str, Dict[str, float]] = {}
+    for m in _INSN_RE.finditer(_strip_fused_computation_bodies(hlo_text)):
+        shape, op, rest = m.groups()
+        if op in _FREE_OPS:
+            continue
+        # split the operand list from the trailing attributes at the
+        # closing paren of the call (best-effort: attributes follow ')')
+        depth, split = 1, len(rest)
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    split = i
+                    break
+        operands, tail = rest[:split], rest[split:]
+        slot = census.setdefault(
+            op, {"count": 0.0, "flops": 0.0, "bytes": 0.0}
+        )
+        slot["count"] += 1
+        slot["bytes"] += hlo_shape_bytes(shape)
+        if op == "dot":
+            slot["flops"] += _dot_flops(shape, operands, tail)
+        elif op == "convolution":
+            slot["flops"] += _conv_flops(shape, operands, tail)
+        elif op in _ELEMENTWISE_OPS:
+            slot["flops"] += _shape_elems(shape)
+    return census
+
+
+# -- the model ----------------------------------------------------------------
+
+
+def roofline_report(
+    compiled,
+    *,
+    device_kind: str,
+    dtype: str,
+    tasks: int = 1,
+    model_flops: Optional[float] = None,
+    peaks: Optional[List[dict]] = None,
+    top_k: int = TOP_K_CONTRIBUTORS,
+) -> dict:
+    """The static roofline report of one compiled executable.
+
+    ``tasks`` is the task count the executable processes per dispatch (per
+    device for a sharded module — ``cost_analysis`` counts the partitioned
+    program), so ``flops_per_task`` is directly comparable to the
+    ``xla_flops_per_task`` the bench records. ``model_flops`` is the
+    *algorithmic* flop count (no remat recompute) when the caller has one
+    — it turns the predicted HFU into a predicted MFU. ``peaks`` overrides
+    the device table (tests perturb it; ``verify_roofline`` then fails the
+    cross-check).
+    """
+    ca = cost_analysis_dict(compiled)
+    flops = float(ca.get("flops") or 0.0)
+    bytes_accessed = float(ca.get("bytes accessed") or 0.0)
+    entry = find_peak_entry(device_kind, peaks)
+    report: dict = {
+        "device_kind": device_kind,
+        "dtype": dtype,
+        "tasks": int(tasks),
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "flops_per_task": flops / tasks if tasks else None,
+        "model_flops": model_flops,
+        "peak_flops": None,
+        "hbm_bytes_per_s": None,
+        "nominal_peaks": None,
+        "arithmetic_intensity": (
+            flops / bytes_accessed if bytes_accessed > 0 else None
+        ),
+        "critical_intensity": None,
+        "bound": None,
+        "predicted_step_seconds": None,
+        "predicted_hfu": None,
+        "predicted_mfu": None,
+        "flops_coverage": None,
+        "top_contributors": [],
+    }
+    if entry is not None:
+        table = entry.get("flops") or {}
+        peak = table.get(dtype, table.get("float32"))
+        bw = entry.get("hbm_bytes_per_s")
+        report["peak_flops"] = float(peak) if peak else None
+        report["hbm_bytes_per_s"] = float(bw) if bw else None
+        report["nominal_peaks"] = bool(entry.get("nominal"))
+    peak = report["peak_flops"]
+    bw = report["hbm_bytes_per_s"]
+    if peak and peak > 0 and bw and bw > 0 and flops > 0:
+        t_compute = flops / peak
+        t_memory = bytes_accessed / bw
+        t = max(t_compute, t_memory)
+        report["critical_intensity"] = peak / bw
+        report["bound"] = "compute" if t_compute >= t_memory else "memory"
+        report["predicted_step_seconds"] = t
+        report["predicted_hfu"] = round(t_compute / t, 4) if t > 0 else None
+        if model_flops and t > 0:
+            report["predicted_mfu"] = round(model_flops / peak / t, 4)
+        # decomposition: charge each opcode class its own roofline time
+        try:
+            census = op_cost_census(compiled.as_text())
+        except Exception:  # noqa: BLE001 - decomposition is best-effort
+            census = {}
+        est_flops = sum(c["flops"] for c in census.values())
+        report["flops_coverage"] = (
+            round(est_flops / flops, 4) if flops > 0 else None
+        )
+        contributors = []
+        for op, c in census.items():
+            t_op = max(c["flops"] / peak, c["bytes"] / bw)
+            contributors.append({
+                "op": op,
+                "count": int(c["count"]),
+                "flops": c["flops"],
+                "bytes": c["bytes"],
+                "seconds": t_op,
+                "bound": (
+                    "compute" if c["flops"] / peak >= c["bytes"] / bw
+                    else "memory"
+                ),
+            })
+        contributors.sort(key=lambda c: c["seconds"], reverse=True)
+        total_t = sum(c["seconds"] for c in contributors) or 1.0
+        for c in contributors:
+            c["time_share"] = round(c["seconds"] / total_t, 4)
+        report["top_contributors"] = contributors[:top_k]
+    return report
+
+
+def verify_roofline(
+    report: dict,
+    program: str,
+    reference_flops_per_task: Optional[float] = None,
+    rel_tol: float = 0.05,
+) -> List[ContractViolation]:
+    """The ``roofline`` contract: the model must have produced a usable
+    prediction (a device-peak entry exists and is positive, the cost
+    analysis yielded flops), and — when a reference is supplied (the
+    ``xla_flops_per_task`` a bench line recorded for the same workload) —
+    the model's flops/task must agree within ``rel_tol``. A perturbed or
+    missing peak-table entry fails here, nowhere else."""
+    violations: List[ContractViolation] = []
+
+    def flag(detail: str) -> None:
+        violations.append(ContractViolation("roofline", program, detail))
+
+    peak = report.get("peak_flops")
+    bw = report.get("hbm_bytes_per_s")
+    if not peak or peak <= 0 or not bw or bw <= 0:
+        flag(
+            f"device-peak table has no usable entry for "
+            f"kind={report.get('device_kind')!r} dtype="
+            f"{report.get('dtype')!r} (peak_flops={peak!r}, "
+            f"hbm_bytes_per_s={bw!r}) — the MFU model cannot run"
+        )
+    if not report.get("flops"):
+        flag("cost_analysis reported no flops; the roofline model has no "
+             "numerator")
+    current = report.get("flops_per_task")
+    if (
+        reference_flops_per_task
+        and current
+        and abs(current - reference_flops_per_task)
+        > rel_tol * reference_flops_per_task
+    ):
+        flag(
+            f"model flops/task {current:.3e} disagrees with the recorded "
+            f"xla_flops_per_task {reference_flops_per_task:.3e} by more "
+            f"than {rel_tol:.0%} — the model is reading a different "
+            "program than the bench measured"
+        )
+    return violations

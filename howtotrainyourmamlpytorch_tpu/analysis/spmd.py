@@ -1,0 +1,498 @@
+"""SPMD performance-contract auditor: the program family under a real mesh.
+
+:mod:`analysis.auditor` verifies donation/transfer/dtype contracts on
+single-device programs; nothing there can answer the questions that
+decide whether a pod reservation survives its first hour: does
+``hybrid_task_mesh`` introduce an accidental all-gather of the resident
+store?  Are the batch arguments actually sharded over ``(data, task)`` or
+is every device redundantly computing the global batch?  Will this config
+OOM per-device before the first checkpoint?  This module compiles the
+canonical six-program family **under a real mesh** (8 fake CPU devices
+via ``--xla_force_host_platform_device_count`` in tests/CI, real chips on
+hardware) and verifies, per ``program@backend@mesh`` key pinned in
+``CONTRACTS.json``:
+
+* ``sharding``          — batch args sharded over ``(data, task)`` per
+  ``parallel.distributed.global_batch_sharding``; state and resident
+  stores replicated on the way in AND the way out (an output that comes
+  back sharded forces a reshard on the next dispatch);
+* ``collective_census`` — all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all counts and byte volumes from the
+  optimized HLO, classified per mesh axis (ICI task axis vs DCN data
+  axis via the replica groups), compared against the mesh-keyed baseline
+  with the op-census semantics (growth fails, shrinkage suggests a
+  re-pin); invariant regardless of baseline: no collective carries uint8
+  (pixel-store) data and none moves store-sized volumes — residency
+  exists so pixels never cross the interconnect;
+* ``hbm_budget``        — the static per-device peak
+  (``memory_analysis``: arguments + outputs + temps - aliased) plus the
+  resident-store expectation against a configured ``hbm_budget_gb``, so
+  an OOM config fails ``cli audit`` on a laptop instead of a pod job;
+* ``roofline``          — the static roofline/MFU model
+  (:mod:`analysis.roofline`) produced a usable prediction for this
+  device, cross-checked against a recorded ``xla_flops_per_task`` when
+  one is supplied.
+
+Audits are fully abstract (``ShapeDtypeStruct`` arguments carrying
+``NamedSharding``\\ s — nothing is allocated); the mesh is the hybrid
+``(data, task)`` mesh of ``parallel.distributed``, degenerating to
+``1xN`` for single-host multi-device runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import MAMLConfig
+from ..core import maml
+from ..ops import device_pipeline
+from ..parallel import distributed, mesh as mesh_lib
+from . import contracts as C
+from . import roofline as R
+from .auditor import _batch_avals, _index_avals, _state_avals, tree_byte_size
+
+#: expected-sharding tags for one top-level argument of an audited program
+BATCH0 = "batch0"          # task axis at dim 0: P((data, task))
+BATCH1 = "batch1"          # stacked k-chunk, task axis at dim 1
+REPLICATED = "replicated"  # state / stores / scalars: P()
+
+_EXPECTED_SPECS = {
+    BATCH0: P((distributed.DATA_AXIS, mesh_lib.TASK_AXIS)),
+    BATCH1: P(None, (distributed.DATA_AXIS, mesh_lib.TASK_AXIS)),
+    REPLICATED: P(),
+}
+
+
+def parse_mesh_spec(spec: str) -> Tuple[int, int]:
+    """``"RxC"`` -> (data_rows, task_cols); raises ValueError on junk."""
+    m = spec.lower().split("x")
+    if len(m) != 2 or not all(p.isdigit() for p in m) or "0" in (m[0], m[1]):
+        raise ValueError(
+            f"mesh spec must be 'RxC' with positive integers "
+            f"(data x task, e.g. '1x8'), got {spec!r}"
+        )
+    return int(m[0]), int(m[1])
+
+
+def mesh_spec_str(rows: int, cols: int) -> str:
+    return f"{rows}x{cols}"
+
+
+def build_audit_mesh(
+    rows: int, cols: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """The hybrid ``(data, task)`` audit mesh over ``rows*cols`` devices —
+    the same construction production uses (``hybrid_task_mesh``), with the
+    row count simulated on single-process backends."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = rows * cols
+    if len(devs) < need:
+        raise ValueError(
+            f"mesh {mesh_spec_str(rows, cols)} needs {need} devices but "
+            f"only {len(devs)} are visible (tests/CI: set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})"
+        )
+    return distributed.hybrid_task_mesh(devices=devs[:need], processes=rows)
+
+
+def _mesh_shape(mesh: Mesh) -> Tuple[int, int]:
+    shape = dict(mesh.shape)
+    return (shape[distributed.DATA_AXIS], shape[mesh_lib.TASK_AXIS])
+
+
+def _sharded(sds, mesh: Mesh, tag: str):
+    return jax.ShapeDtypeStruct(
+        sds.shape, sds.dtype,
+        sharding=NamedSharding(mesh, _EXPECTED_SPECS[tag]),
+    )
+
+
+def _spec_of(sharding) -> Optional[P]:
+    return getattr(sharding, "spec", None)
+
+
+def _stripped(spec) -> Optional[Tuple]:
+    """A PartitionSpec as a trailing-None-stripped tuple (GSPMD pads and
+    truncates unsharded trailing dims freely); None when the sharding
+    exposes no spec."""
+    if spec is None:
+        return None
+    t = tuple(spec)
+    while t and t[-1] is None:
+        t = t[:-1]
+    return t
+
+
+class SpmdAuditor:
+    """Verify the SPMD performance contracts on jitted callables.
+
+    ``baseline`` / ``config_fingerprint`` arm the mesh-keyed collective
+    census compare exactly like the op census (``baseline_comparable``);
+    ``hbm_budget_gb`` (fallback: ``cfg.hbm_budget_gb``; 0 disables)
+    bounds the static per-device peak; ``peaks`` overrides the device
+    roofline table (tests perturb it)."""
+
+    def __init__(
+        self,
+        cfg: MAMLConfig,
+        mesh: Mesh,
+        baseline: Optional[dict] = None,
+        config_fingerprint: str = "",
+        hbm_budget_gb: Optional[float] = None,
+        peaks: Optional[List[dict]] = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.rows, self.cols = _mesh_shape(mesh)
+        self.baseline = baseline
+        self.peaks = peaks
+        self.hbm_budget_gb = (
+            cfg.hbm_budget_gb if hbm_budget_gb is None else hbm_budget_gb
+        )
+        self._census_armed = C.baseline_comparable(
+            baseline,
+            jax_version=jax.__version__,
+            config_fingerprint=config_fingerprint,
+        )
+
+    @property
+    def mesh_spec(self) -> str:
+        return mesh_spec_str(self.rows, self.cols)
+
+    # -- the audit ---------------------------------------------------------
+
+    def audit(
+        self,
+        program: str,
+        jitted,
+        args: Sequence[Any],
+        expected: Sequence[str],
+        donate: Tuple[int, ...] = (),
+        expect_replicated_outputs: bool = True,
+        store_bytes: int = 0,
+        model_flops: Optional[float] = None,
+        reference_flops_per_task: Optional[float] = None,
+    ) -> C.SpmdAuditReport:
+        """Compile ``jitted(*args)`` under the mesh and check the SPMD
+        contracts. ``expected`` tags each top-level argument (``BATCH0`` /
+        ``BATCH1`` / ``REPLICATED``) with the sharding the contract
+        demands — independent of what ``args`` actually carry, so a
+        mutation that drops the batch sharding is caught, not blessed.
+        ``store_bytes`` arms the store-sized-collective rule."""
+        violations: List[C.ContractViolation] = []
+
+        def flag(contract: str, detail: str) -> None:
+            violations.append(C.ContractViolation(contract, program, detail))
+
+        compiled = jitted.trace(*args).lower().compile()
+        hlo_text = compiled.as_text()
+
+        self._check_shardings(
+            program, compiled, args, expected, expect_replicated_outputs,
+            flag,
+        )
+        collectives = C.collective_census(hlo_text, self.rows, self.cols)
+        self._check_collectives(
+            program, hlo_text, collectives, store_bytes, flag
+        )
+        hbm = self._check_hbm(compiled, store_bytes, flag)
+        tasks = self._tasks_per_device()
+        roofline = R.roofline_report(
+            compiled,
+            device_kind=jax.devices()[0].device_kind,
+            dtype=self.cfg.compute_dtype,
+            tasks=tasks,
+            model_flops=model_flops,
+            peaks=self.peaks,
+        )
+        violations.extend(
+            R.verify_roofline(
+                roofline, program,
+                reference_flops_per_task=reference_flops_per_task,
+            )
+        )
+        donation = C.donation_stats(compiled, donate) if donate else None
+        return C.SpmdAuditReport(
+            program=program,
+            backend=jax.default_backend(),
+            contracts_checked=C.SPMD_CONTRACT_NAMES,
+            violations=violations,
+            census=C.interesting_census(hlo_text),
+            donation=donation,
+            mesh_spec=self.mesh_spec,
+            collectives=collectives,
+            hbm=hbm,
+            roofline=roofline,
+        )
+
+    def _tasks_per_device(self) -> int:
+        n_dev = self.rows * self.cols
+        return max(1, self.cfg.batch_size // n_dev)
+
+    def _check_shardings(
+        self, program, compiled, args, expected, expect_replicated_outputs,
+        flag,
+    ) -> None:
+        if len(args) != len(expected):
+            raise ValueError(
+                f"{program}: {len(args)} args but {len(expected)} "
+                "expected-sharding tags"
+            )
+        try:
+            in_shardings, _ = compiled.input_shardings
+            out_shardings = compiled.output_shardings
+        except Exception as e:  # noqa: BLE001 - backend without the API
+            flag("sharding",
+                 f"compiled executable exposes no shardings ({e!r}); the "
+                 "sharding contract is unverifiable")
+            return
+        # input_shardings mirrors the call's top-level arguments: one
+        # entry per arg, itself a pytree of per-leaf shardings. Leaves the
+        # executable PRUNED (an unused rot_k under augment=False, the Adam
+        # moments in an eval step) carry no sharding — every leaf that
+        # survived must still match the arg's contract spec, which is
+        # uniform per argument, so partial pairing verifies exactly the
+        # leaves that exist on device.
+        if len(in_shardings) != len(args):
+            flag("sharding",
+                 f"{len(in_shardings)} committed input shardings for "
+                 f"{len(args)} arguments — cannot verify")
+            return
+        for argnum, (arg, tag, arg_sh) in enumerate(
+            zip(args, expected, in_shardings)
+        ):
+            want = _stripped(_EXPECTED_SPECS[tag])
+            for sh in jax.tree_util.tree_leaves(arg_sh):
+                committed = _stripped(_spec_of(sh))
+                if committed != want:
+                    flag(
+                        "sharding",
+                        f"arg {argnum} ({tag}) leaf committed sharding "
+                        f"spec {committed} != contract {want} — "
+                        + (
+                            "the batch is not sharded over (data, task): "
+                            "every device computes the global batch "
+                            "redundantly"
+                            if tag in (BATCH0, BATCH1)
+                            else "state/store must stay replicated"
+                        ),
+                    )
+                    break  # one violation per argument, not per leaf
+        if expect_replicated_outputs:
+            for i, sh in enumerate(jax.tree_util.tree_leaves(out_shardings)):
+                spec = _spec_of(sh)
+                if spec is not None and tuple(spec) and any(
+                    s is not None for s in tuple(spec)
+                ):
+                    flag(
+                        "sharding",
+                        f"output leaf {i} comes back sharded ({spec}) — a "
+                        "sharded new state forces a reshard/all-gather on "
+                        "the next dispatch",
+                    )
+                    break
+
+    def _check_collectives(
+        self, program, hlo_text, collectives, store_bytes, flag
+    ) -> None:
+        # invariants (baseline-free): pixel/store bytes never cross the
+        # interconnect — no uint8 collective, nothing store-sized
+        insns = C.collective_instructions(hlo_text)
+        u8 = [i for i in insns if "u8[" in i["shape"]]
+        if u8:
+            flag(
+                "collective_census",
+                f"{len(u8)} collective(s) carry uint8 (pixel-store) data "
+                f"(e.g. {u8[0]['op']} {u8[0]['shape']}) — the replicated "
+                "store is being gathered/resharded inside the step",
+            )
+        if store_bytes > 0:
+            big = [i for i in insns if i["bytes"] >= store_bytes]
+            if big:
+                flag(
+                    "collective_census",
+                    f"collective {big[0]['op']} moves {big[0]['bytes']} "
+                    f"bytes >= the {store_bytes}-byte resident store — "
+                    "store-sized data is crossing the interconnect",
+                )
+        if self._census_armed:
+            key = C.spmd_census_key(
+                program, jax.default_backend(), self.mesh_spec
+            )
+            pinned = (self.baseline or {}).get("programs", {}).get(key)
+            if pinned is not None:
+                regressions = C.compare_collective_census(
+                    collectives, pinned.get("collectives", {})
+                )
+                if regressions:
+                    flag(
+                        "collective_census",
+                        "collective census regression vs pinned baseline: "
+                        + ", ".join(regressions),
+                    )
+
+    def _check_hbm(self, compiled, store_bytes, flag) -> Optional[dict]:
+        try:
+            ma = compiled.memory_analysis()
+            hbm = {
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "alias_bytes": int(ma.alias_size_in_bytes),
+            }
+        except Exception as e:  # noqa: BLE001 - backend without the API
+            if self.hbm_budget_gb > 0:
+                flag("hbm_budget",
+                     f"memory_analysis unavailable ({e!r}); the HBM budget "
+                     "is unverifiable on this backend")
+            return None
+        # static per-device peak: args + outputs + temps, minus the donated
+        # aliases counted twice, plus the resident stores the step expects
+        # in HBM beside it
+        peak = (
+            hbm["argument_bytes"] + hbm["output_bytes"] + hbm["temp_bytes"]
+            - hbm["alias_bytes"]
+        )
+        hbm["peak_bytes"] = peak
+        hbm["store_bytes_expected"] = int(store_bytes)
+        hbm["budget_gb"] = float(self.hbm_budget_gb)
+        if self.hbm_budget_gb > 0:
+            budget = self.hbm_budget_gb * 2**30
+            if peak > budget:
+                flag(
+                    "hbm_budget",
+                    f"static per-device peak {peak / 2**30:.3f} GiB "
+                    f"(args {hbm['argument_bytes']} + outputs "
+                    f"{hbm['output_bytes']} + temps {hbm['temp_bytes']} - "
+                    f"aliased {hbm['alias_bytes']}) exceeds hbm_budget_gb="
+                    f"{self.hbm_budget_gb} — this config OOMs before a "
+                    "TPU reservation is burned",
+                )
+        return hbm
+
+
+# -- the canonical family under the mesh --------------------------------------
+
+
+def audit_spmd_programs(
+    cfg: MAMLConfig,
+    mesh: Optional[Mesh] = None,
+    auditor: Optional[SpmdAuditor] = None,
+    second_order: Optional[bool] = None,
+    k: int = 2,
+    programs: Optional[Sequence[str]] = None,
+) -> List[C.SpmdAuditReport]:
+    """Audit the canonical six-program family under ``mesh`` (default: a
+    1xN hybrid mesh over every visible device). The batch size is rounded
+    up to the mesh size when it does not divide it — the audit needs a
+    shardable batch, and the census keys carry the mesh so rounded and
+    exact configs never compare against each other's entries."""
+    if mesh is None and auditor is not None:
+        mesh = auditor.mesh
+    if mesh is None:
+        mesh = build_audit_mesh(1, len(jax.devices()))
+    rows, cols = _mesh_shape(mesh)
+    n_dev = rows * cols
+    if cfg.batch_size % n_dev != 0:
+        cfg = cfg.replace(
+            batch_size=max(1, -(-cfg.batch_size // n_dev)) * n_dev
+        )
+    if auditor is None:
+        auditor = SpmdAuditor(cfg, mesh)
+    else:
+        auditor.cfg = cfg
+    so = cfg.second_order if second_order is None else bool(second_order)
+    so_tag = int(so)
+
+    def rep(tree):
+        return jax.tree_util.tree_map(
+            lambda s: _sharded(s, mesh, REPLICATED), tree
+        )
+
+    state = rep(_state_avals(cfg))
+    weights = _sharded(
+        jax.ShapeDtypeStruct(
+            (cfg.number_of_training_steps_per_iter,), jnp.float32
+        ), mesh, REPLICATED,
+    )
+    lr = _sharded(jax.ShapeDtypeStruct((), jnp.float32), mesh, REPLICATED)
+    batch = tuple(_sharded(b, mesh, BATCH0) for b in _batch_avals(cfg))
+    batch_k = tuple(_sharded(b, mesh, BATCH1) for b in _batch_avals(cfg, k))
+    store_sds, gather_sds, rot_sds = _index_avals(cfg)
+    store = _sharded(store_sds, mesh, REPLICATED)
+    gather = _sharded(gather_sds, mesh, BATCH0)
+    rot_k = _sharded(rot_sds, mesh, BATCH0)
+    _, gather_k_sds, rot_k_k_sds = _index_avals(cfg, k)
+    gather_k = _sharded(gather_k_sds, mesh, BATCH1)
+    rot_k_k = _sharded(rot_k_k_sds, mesh, BATCH1)
+    store_bytes = tree_byte_size(store)
+
+    b0, b1, rp = BATCH0, BATCH1, REPLICATED
+    specs: List[tuple] = [
+        (
+            f"train_step[so={so_tag}]",
+            jax.jit(maml.make_train_step(cfg, so),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, *batch, weights, lr),
+            (rp, b0, b0, b0, b0, rp, rp),
+            maml.TRAIN_DONATE, True, 0,
+        ),
+        (
+            f"train_multi_step[so={so_tag},k={k}]",
+            jax.jit(maml.make_train_multi_step(cfg, so),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, *batch_k, weights, lr),
+            (rp, b1, b1, b1, b1, rp, rp),
+            maml.TRAIN_DONATE, True, 0,
+        ),
+        (
+            f"train_step_indexed[so={so_tag}]",
+            jax.jit(maml.make_train_step_indexed(cfg, so, augment=False),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, store, gather, rot_k, weights, lr),
+            (rp, rp, b0, b0, rp, rp),
+            maml.TRAIN_DONATE, True, store_bytes,
+        ),
+        (
+            f"train_multi_step_indexed[so={so_tag},k={k}]",
+            jax.jit(maml.make_train_multi_step_indexed(cfg, so,
+                                                       augment=False),
+                    donate_argnums=maml.TRAIN_DONATE),
+            (state, store, gather_k, rot_k_k, weights, lr),
+            (rp, rp, b1, b1, rp, rp),
+            maml.TRAIN_DONATE, True, store_bytes,
+        ),
+        (
+            f"eval_multi_step[k={k}]",
+            jax.jit(maml.make_eval_multi_step(cfg, with_preds=False)),
+            (state, *batch_k),
+            (rp, b1, b1, b1, b1),
+            (), True, 0,
+        ),
+        (
+            "index_expander",
+            jax.jit(device_pipeline.make_index_expander(cfg, augment=False)),
+            (store, gather, rot_k),
+            (rp, b0, b0),
+            # outputs are the expanded per-task pixel batches: sharded over
+            # the task axis BY DESIGN
+            (), False, store_bytes,
+        ),
+    ]
+    reports = []
+    for name, jitted, args, expected, donate, rep_out, sbytes in specs:
+        if programs is not None and name not in programs:
+            continue
+        reports.append(
+            auditor.audit(
+                name, jitted, args, expected,
+                donate=donate,
+                expect_replicated_outputs=rep_out,
+                store_bytes=sbytes,
+            )
+        )
+    return reports

@@ -21,11 +21,15 @@ works on a machine with nothing but the repo and numpy installed.
 The ``lint`` subcommand (analysis/lint.py — pure stdlib, also dispatched
 jax-free) runs the repo-specific JAX-pitfall linter; the ``audit``
 subcommand (tools/audit_cli.py — needs jax) statically verifies the
-program contracts (donation / no-transfer / dtype policy / op census)
-on the jitted program family:
+program contracts (donation / no-transfer / dtype policy / op census) on
+the jitted program family — and, with ``--mesh RxC``, the SPMD
+performance contracts (sharding / per-axis collective census / static
+HBM budget / roofline) with the family compiled under a real hybrid
+(data, task) mesh:
 
     python -m howtotrainyourmamlpytorch_tpu.cli lint
     python -m howtotrainyourmamlpytorch_tpu.cli audit [--pin]
+    python -m howtotrainyourmamlpytorch_tpu.cli audit --mesh 1x8 [--pin]
 
 Exit codes: 0 on success; ``resilience.PREEMPT_EXIT_CODE`` (75) when a
 SIGTERM/SIGINT preemption was drained gracefully (emergency checkpoint on

@@ -325,6 +325,14 @@ class MAMLConfig:
     #            (analysis.AuditError) and a retrace fails the run
     #            (analysis.auditor.RetraceError).
     analysis_level: str = "off"  # 'off' | 'warn' | 'strict'
+    # static per-device HBM budget (GiB) for the SPMD audit
+    # (analysis/spmd.py): when > 0, the build-time audit of multi-device
+    # runs (and `cli audit --mesh`) verifies the compiled step's static
+    # per-device peak (memory_analysis: args + outputs + temps - aliased)
+    # fits the budget — an OOM config fails the audit on a laptop instead
+    # of a pod job. 0 (default) disables the check. Set it to the chip's
+    # usable HBM (e.g. 16 for TPU v5e) minus headroom.
+    hbm_budget_gb: float = 0.0
 
     # persistent XLA compilation cache: resumed runs (and repeated runs of
     # the same config) skip the 20-40s TPU compile of the train/eval steps.
@@ -454,6 +462,11 @@ class MAMLConfig:
             raise ValueError(
                 f"analysis_level must be 'off', 'warn' or 'strict', got "
                 f"{self.analysis_level!r}"
+            )
+        if self.hbm_budget_gb < 0:
+            raise ValueError(
+                f"hbm_budget_gb must be >= 0 (0 disables the static HBM "
+                f"budget check), got {self.hbm_budget_gb}"
             )
         if self.health_level not in ("off", "monitor", "halt"):
             raise ValueError(

@@ -674,9 +674,14 @@ class ExperimentBuilder:
         against the pinned contracts NOW — before an epoch of compute is
         sunk into a program that double-buffers its state or re-compiles
         every dispatch — and install the runtime retrace detector on the
-        system's dispatch sites. 'warn' logs violations and telemeters
-        retraces; 'strict' raises (AuditError here, RetraceError at the
-        offending dispatch)."""
+        system's dispatch sites. Multi-device single-host builds
+        additionally run the SPMD performance audit (analysis/spmd.py)
+        under a 1xN hybrid mesh over the model's devices: sharding,
+        per-axis collective census, static HBM budget and the roofline
+        model — so an accidental store gather or an over-budget config
+        fails the BUILD, not the pod job. 'warn' logs violations and
+        telemeters retraces; 'strict' raises (AuditError here,
+        RetraceError at the offending dispatch)."""
         import dataclasses as _dc
 
         import jax
@@ -709,20 +714,60 @@ class ExperimentBuilder:
                 self._log(
                     "[analysis] pinned CONTRACTS.json baseline is not "
                     "comparable to this run (different jax version or "
-                    "audit config); op-census regression check skipped, "
+                    "audit config); census regression checks skipped, "
                     "invariant contracts still enforced"
                 )
             auditor = audit_lib.ProgramAuditor(
                 cfg, baseline=baseline, config_fingerprint=fingerprint
             )
             reports = audit_lib.audit_system_programs(cfg, auditor=auditor)
-            violations = [v for r in reports for v in r.violations]
+            spmd_reports = []
+            if self.model.mesh is not None:
+                spmd_reports = self._audit_spmd(
+                    baseline, fingerprint
+                )
+            violations = [
+                v for r in list(reports) + spmd_reports for v in r.violations
+            ]
             for v in violations:
                 print(f"[analysis] CONTRACT VIOLATION {v}",
                       file=sys.stderr, flush=True)
             self._log(
-                f"[analysis] program audit: {len(reports)} program(s), "
-                f"{len(violations)} violation(s)"
+                f"[analysis] program audit: {len(reports)} program(s)"
+                + (
+                    f" + {len(spmd_reports)} SPMD program(s)"
+                    if spmd_reports else ""
+                )
+                + f", {len(violations)} violation(s)"
+            )
+            roofline_summary = None
+            mesh_spec = None
+            if spmd_reports:
+                mesh_spec = spmd_reports[0].mesh_spec
+                # surface the flagship train step's roofline in telemetry:
+                # `cli inspect summary` prints it as the analysis line
+                first = next(
+                    (r for r in spmd_reports
+                     if r.program.startswith("train_step[")
+                     and r.roofline is not None),
+                    None,
+                )
+                if first is not None:
+                    roofline_summary = {
+                        "program": first.program,
+                        "bound": first.roofline.get("bound"),
+                        "predicted_hfu": first.roofline.get("predicted_hfu"),
+                        "predicted_mfu": first.roofline.get("predicted_mfu"),
+                        "flops_per_task": first.roofline.get(
+                            "flops_per_task"
+                        ),
+                    }
+            self.telemetry.event(
+                "analysis",
+                programs=len(reports) + len(spmd_reports),
+                violations=len(violations),
+                mesh=mesh_spec,
+                roofline=roofline_summary,
             )
             if violations and strict:
                 raise contracts_lib.AuditError(violations)
@@ -730,6 +775,32 @@ class ExperimentBuilder:
             on_retrace=self._on_retrace, strict=strict
         )
         self.model.retrace_detector = self.retrace_detector
+
+    def _audit_spmd(self, baseline, fingerprint):
+        """The SPMD half of the build-time audit: the program family under
+        a 1xN hybrid mesh over the model's task-mesh devices (single-host
+        multi-device builds only — the callers gate). Failures inside the
+        audit itself degrade to a warning: the audit must never be the
+        thing that kills a run the contracts would have passed."""
+        from ..analysis import spmd as spmd_lib
+
+        devices = list(self.model.mesh.devices.flat)
+        try:
+            mesh = spmd_lib.build_audit_mesh(1, len(devices), devices)
+            auditor = spmd_lib.SpmdAuditor(
+                self.cfg, mesh, baseline=baseline,
+                config_fingerprint=fingerprint,
+            )
+            return spmd_lib.audit_spmd_programs(
+                self.cfg, mesh=mesh, auditor=auditor
+            )
+        except Exception as e:  # noqa: BLE001 - best-effort build audit
+            print(
+                f"[analysis] SPMD audit unavailable ({e!r}); sharding/"
+                "collective/HBM contracts not checked at build time",
+                file=sys.stderr, flush=True,
+            )
+            return []
 
     def _on_retrace(self, site: str, signature: str,
                     n_signatures: int) -> None:

@@ -51,7 +51,15 @@ Record kinds:
   distinct signatures the site has now compiled. Every retrace is 20-40s
   of TPU compile the shape discipline should have prevented; under
   ``analysis_level='strict'`` the record is followed by a fatal
-  RetraceError.
+  RetraceError;
+* ``analysis``       — the build-time program audit ran
+  (``analysis_level != 'off'``): how many programs were audited (incl.
+  the SPMD family on multi-device builds), how many contract violations
+  were found, the audit ``mesh`` (``"1x8"``-style, null single-device)
+  and — when the SPMD audit ran — the flagship train step's static
+  ``roofline`` summary (bound, predicted HFU/MFU, flops/task), so
+  ``cli inspect summary`` can say where the MFU number goes without the
+  run's stdout.
 
 Version history / migration notes:
 
@@ -81,6 +89,12 @@ Version history / migration notes:
   (``tests/fixtures/telemetry_v3_schema.jsonl`` pins a v3-era log) and
   the forward-compat rules carry over (the future-schema fixture is
   re-pinned at v5-unknown).
+* **v5** — adds the ``analysis`` record kind (the build-time program
+  audit summary: program/violation counts, the SPMD audit mesh and the
+  flagship roofline summary). Pure addition: every v1..v4 record
+  validates unchanged (``tests/fixtures/telemetry_v4_schema.jsonl`` pins
+  a v4-era log) and the forward-compat rules carry over (the
+  future-schema fixture is re-pinned at v6-unknown).
 """
 
 from __future__ import annotations
@@ -88,7 +102,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -111,6 +125,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "retry": ("site", "attempt", "max_attempts", "error"),
     "preemption": ("iter", "signal", "checkpoint"),
     "retrace": ("iter", "site", "signature"),
+    "analysis": ("programs", "violations"),
 }
 
 

@@ -8,12 +8,28 @@ reports per-program contract results. With ``--pin`` it re-pins the
 against it — the re-pinning workflow after an *intentional* lowering
 change (see README "Static analysis & program contracts").
 
+With ``--mesh RxC`` the same family is compiled under a real hybrid
+``(data, task)`` mesh (R data rows x C task columns) and verified against
+the SPMD performance contracts instead (``analysis.spmd``): sharding
+(batch over ``(data, task)``, state/stores replicated), the per-axis
+collective census against the mesh-keyed ``program@backend@RxC`` baseline
+entries, the static per-device HBM budget (``--hbm-budget-gb`` /
+``cfg.hbm_budget_gb``), and the roofline model (``analysis.roofline``) —
+whose per-program report the CLI prints, decomposing the predicted MFU
+into its top opcode contributors. On a CPU host the devices are virtual:
+``--mesh 1x8`` forces ``--xla_force_host_platform_device_count=8`` before
+jax loads (harmless on real hardware — the flag only affects the host
+platform).
+
 .. code-block:: console
 
    python -m howtotrainyourmamlpytorch_tpu.cli audit
    python -m howtotrainyourmamlpytorch_tpu.cli audit --json
    python -m howtotrainyourmamlpytorch_tpu.cli audit --pin
-   python -m howtotrainyourmamlpytorch_tpu.cli audit --config cfg.json
+   python -m howtotrainyourmamlpytorch_tpu.cli audit --mesh 1x8
+   python -m howtotrainyourmamlpytorch_tpu.cli audit --mesh 2x4 --pin
+   python -m howtotrainyourmamlpytorch_tpu.cli audit --config cfg.json \
+       --mesh 1x8 --hbm-budget-gb 16
 
 Without ``--config`` the audit runs the pinned *audit config* (a small
 deterministic MAML++ config with every mechanism on — the one the
@@ -31,6 +47,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -68,12 +85,54 @@ def audit_config():
     )
 
 
+def _report_payload(r) -> dict:
+    payload = {
+        "ok": r.ok,
+        "violations": [
+            {"contract": v.contract, "detail": v.detail}
+            for v in r.violations
+        ],
+        "census": r.census,
+        "donation": r.donation,
+    }
+    for extra in ("mesh_spec", "collectives", "hbm", "roofline"):
+        value = getattr(r, extra, None)
+        if value is not None and value != "":
+            payload[extra] = value
+    return payload
+
+
+def _print_roofline(roofline: dict) -> None:
+    mfu = roofline.get("predicted_mfu")
+    hfu = roofline.get("predicted_hfu")
+    bound = roofline.get("bound")
+    if bound is None:
+        return
+    print(
+        f"       roofline: {bound}-bound, predicted hfu "
+        f"{hfu if hfu is not None else '?'}"
+        + (f", mfu {mfu}" if mfu is not None else "")
+        + (
+            f", flops/task {roofline['flops_per_task']:.3e}"
+            if roofline.get("flops_per_task") else ""
+        )
+    )
+    for c in roofline.get("top_contributors", [])[:3]:
+        print(
+            f"         {c['op']:<14s} {c['time_share']:>6.1%} of predicted "
+            f"time ({c['bound']}-bound, {c['bytes']:.3g} B, "
+            f"{c['flops']:.3g} flops)"
+        )
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="audit",
         description="Statically verify the program contracts (donation, "
-                    "no-transfer, dtype policy, op census) on the jitted "
-                    "program family",
+                    "no-transfer, dtype policy, op census — or, with "
+                    "--mesh, the SPMD contracts: sharding, collective "
+                    "census, HBM budget, roofline) on the jitted program "
+                    "family",
     )
     parser.add_argument("--config", default=None,
                         help="experiment JSON to audit (default: the "
@@ -82,11 +141,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="baseline path (default: CONTRACTS.json at "
                              "the repo root)")
     parser.add_argument("--pin", action="store_true",
-                        help="re-pin the op-census baseline from this run "
+                        help="re-pin the census baseline from this run "
                              "instead of comparing against it")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable JSON output")
+    parser.add_argument("--mesh", default=None, metavar="RxC",
+                        help="audit under a hybrid (data, task) mesh of "
+                             "R x C devices against the SPMD contracts "
+                             "(e.g. 1x8)")
+    parser.add_argument("--hbm-budget-gb", type=float, default=None,
+                        help="static per-device HBM budget in GiB for the "
+                             "--mesh audit (overrides cfg.hbm_budget_gb; "
+                             "0 disables)")
     args = parser.parse_args(argv)
+
+    mesh_shape = None
+    if args.mesh is not None:
+        from ..analysis.spmd import parse_mesh_spec
+
+        try:
+            mesh_shape = parse_mesh_spec(args.mesh)
+        except ValueError as e:
+            print(f"audit: {e}", file=sys.stderr)
+            return 2
+        # must happen BEFORE jax first loads: give the host platform
+        # enough virtual devices for the requested mesh (no effect on a
+        # backend whose real chips already exist)
+        need = mesh_shape[0] * mesh_shape[1]
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
 
     import jax
 
@@ -98,6 +184,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg = MAMLConfig.from_json_file(args.config)
     else:
         cfg = audit_config()
+    # the fingerprint guards the census compare against SHAPE drift; the
+    # budget override is an audit knob that never changes the compiled
+    # program, so it is passed to the auditor directly — folding it into
+    # cfg before fingerprinting would silently disarm the compare (and
+    # make --pin treat the on-disk baseline as foreign)
     fingerprint = contracts_lib.config_fingerprint(dataclasses.asdict(cfg))
     baseline_path = args.contracts or contracts_lib.default_baseline_path()
     baseline = None if args.pin else contracts_lib.load_baseline(baseline_path)
@@ -109,13 +200,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"(pinned jax={baseline.get('jax')} fingerprint="
             f"{baseline.get('config_fingerprint')}, current "
             f"jax={jax.__version__} fingerprint={fingerprint}); "
-            "op-census regression check skipped — re-pin with --pin",
+            "census regression check skipped — re-pin with --pin",
             file=sys.stderr,
         )
-    auditor = audit_lib.ProgramAuditor(
-        cfg, baseline=baseline, config_fingerprint=fingerprint
-    )
-    reports = audit_lib.audit_system_programs(cfg, auditor=auditor)
+
+    mesh_spec = None
+    if mesh_shape is not None:
+        from ..analysis import spmd as spmd_lib
+
+        try:
+            mesh = spmd_lib.build_audit_mesh(*mesh_shape)
+        except ValueError as e:
+            print(f"audit: {e}", file=sys.stderr)
+            return 2
+        auditor = spmd_lib.SpmdAuditor(
+            cfg, mesh, baseline=baseline, config_fingerprint=fingerprint,
+            hbm_budget_gb=args.hbm_budget_gb,
+        )
+        mesh_spec = auditor.mesh_spec
+        reports = spmd_lib.audit_spmd_programs(cfg, mesh=mesh, auditor=auditor)
+    else:
+        auditor = audit_lib.ProgramAuditor(
+            cfg, baseline=baseline, config_fingerprint=fingerprint
+        )
+        reports = audit_lib.audit_system_programs(cfg, auditor=auditor)
     violations = [v for r in reports for v in r.violations]
 
     if args.pin:
@@ -125,11 +233,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             backend=jax.default_backend(),
             config_fingerprint=fingerprint,
             reports=reports,
+            mesh_spec=mesh_spec,
         )
         print(
-            f"audit: pinned {len(data['programs'])} program census(es) to "
-            f"{baseline_path} (jax {jax.__version__}, backend "
-            f"{jax.default_backend()})",
+            f"audit: pinned {len(reports)} program census(es) "
+            + (f"for mesh {mesh_spec} " if mesh_spec else "")
+            + f"to {baseline_path} ({len(data['programs'])} entries total, "
+            f"jax {jax.__version__}, backend {jax.default_backend()})",
             file=sys.stderr,
         )
 
@@ -139,17 +249,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "backend": jax.default_backend(),
                 "jax": jax.__version__,
                 "config_fingerprint": fingerprint,
+                "mesh": mesh_spec,
                 "programs": {
-                    r.program: {
-                        "ok": r.ok,
-                        "violations": [
-                            {"contract": v.contract, "detail": v.detail}
-                            for v in r.violations
-                        ],
-                        "census": r.census,
-                        "donation": r.donation,
-                    }
-                    for r in reports
+                    r.program: _report_payload(r) for r in reports
                 },
             },
             indent=2,
@@ -160,12 +262,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             status = "ok" if r.ok else "FAIL"
             alias = (r.donation or {}).get("alias_size_bytes")
             extra = f"  alias={alias}B" if alias is not None else ""
+            hbm = getattr(r, "hbm", None)
+            if hbm and "peak_bytes" in hbm:
+                extra += f"  hbm_peak={hbm['peak_bytes'] / 2**30:.4f}GiB"
+            colls = getattr(r, "collectives", None)
+            if colls:
+                parts = [
+                    f"{op}@{axis}x{stats['count']}"
+                    for op, by_axis in sorted(colls.items())
+                    for axis, stats in sorted(by_axis.items())
+                ]
+                extra += "  coll=" + ",".join(parts)
             print(f"{status:4s} {r.program}{extra}")
             for v in r.violations:
                 print(f"     {v}")
+            roofline = getattr(r, "roofline", None)
+            if roofline:
+                _print_roofline(roofline)
         print(
-            f"audit: {len(reports)} program(s), {len(violations)} "
-            f"contract violation(s)",
+            f"audit: {len(reports)} program(s)"
+            + (f" on mesh {mesh_spec}" if mesh_spec else "")
+            + f", {len(violations)} contract violation(s)",
             file=sys.stderr,
         )
     if args.pin:

@@ -181,6 +181,20 @@ def cmd_summary(args) -> int:
         # detector caught — every one is 20-40s of TPU compile the shape
         # discipline should have prevented
         "retraces": counts.get("retrace", 0),
+        # build-time program audit summary (schema v5): the last
+        # `analysis` record — program/violation counts, the SPMD audit
+        # mesh and the flagship roofline prediction
+        "audit": next(
+            (
+                {
+                    k: r.get(k)
+                    for k in ("programs", "violations", "mesh", "roofline")
+                }
+                for r in reversed(records)
+                if r.get("kind") == "analysis"
+            ),
+            None,
+        ),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -247,6 +261,25 @@ def cmd_summary(args) -> int:
             f"  analysis: {payload['retraces']} mid-run retrace(s) — "
             "dispatch sites recompiled (see the anomalies timeline)"
         )
+    audit = payload["audit"]
+    if audit:
+        line = (
+            f"  audit: {audit.get('programs')} program(s), "
+            f"{audit.get('violations')} violation(s)"
+        )
+        if audit.get("mesh"):
+            line += f" on mesh {audit['mesh']}"
+        roof = audit.get("roofline") or {}
+        if roof.get("bound"):
+            line += (
+                f"; roofline[{roof.get('program')}]: "
+                f"{roof['bound']}-bound"
+            )
+            if roof.get("predicted_mfu") is not None:
+                line += f", predicted mfu {roof['predicted_mfu']}"
+            elif roof.get("predicted_hfu") is not None:
+                line += f", predicted hfu {roof['predicted_hfu']}"
+        lines.append(line)
     _emit(payload, args.json, lines)
     return 0
 

@@ -135,3 +135,26 @@ def audit_reports(micro_cfg):
     from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
 
     return audit_lib.audit_system_programs(micro_cfg)
+
+
+@pytest.fixture(scope="session")
+def spmd_micro_cfg() -> MAMLConfig:
+    """The micro config at a mesh-divisible batch (8 tasks over the 8
+    virtual devices) — what the SPMD audits compile."""
+    return make_micro_cfg(batch_size=8)
+
+
+@pytest.fixture(scope="session")
+def spmd_audit_reports(spmd_micro_cfg):
+    """One SPMD audit of the canonical family under a 2x4 hybrid
+    (data, task) mesh — both mesh axes exist, so the collective census
+    exercises its ICI/DCN/both classification — compiled ONCE per test
+    session and shared by test_spmd.py and the re-expressed sharding
+    contract tests in test_parallel.py."""
+    from howtotrainyourmamlpytorch_tpu.analysis import spmd as spmd_lib
+
+    mesh = spmd_lib.build_audit_mesh(2, 4)
+    auditor = spmd_lib.SpmdAuditor(spmd_micro_cfg, mesh)
+    return spmd_lib.audit_spmd_programs(
+        spmd_micro_cfg, mesh=mesh, auditor=auditor
+    )

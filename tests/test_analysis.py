@@ -438,6 +438,7 @@ class _BuilderShim:
     )
 
     _install_analysis = _EB._install_analysis
+    _audit_spmd = _EB._audit_spmd
     _on_retrace = _EB._on_retrace
 
 
@@ -486,6 +487,79 @@ def test_builder_warn_installs_detector_and_logs(monkeypatch, tmp_path):
     assert schema.validate_file(log) >= 1
     kinds = [json.loads(line)["kind"] for line in open(log) if line.strip()]
     assert "retrace" in kinds
+
+
+def _fake_spmd_reports(violations, mesh_spec="1x8"):
+    return [
+        contracts_lib.SpmdAuditReport(
+            program="train_step[so=1]",
+            backend="cpu",
+            contracts_checked=contracts_lib.SPMD_CONTRACT_NAMES,
+            violations=violations,
+            mesh_spec=mesh_spec,
+            collectives={"all-reduce": {"ici": {"count": 2, "bytes": 64}}},
+            roofline={
+                "bound": "memory", "predicted_hfu": 0.2,
+                "predicted_mfu": None, "flops_per_task": 1.0e6,
+            },
+        )
+    ]
+
+
+def test_builder_mesh_build_runs_spmd_audit(monkeypatch, tmp_path):
+    """On a multi-device single-host build, _install_analysis adds the
+    SPMD audit to the base one: its violations are logged and the
+    telemetry `analysis` record (schema v5) carries the mesh and the
+    flagship roofline summary."""
+    from howtotrainyourmamlpytorch_tpu.analysis import spmd as spmd_lib
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import Telemetry
+
+    cfg = make_micro_cfg(
+        batch_size=8, analysis_level="warn", telemetry_level="scalars"
+    )
+    model = MAMLFewShotClassifier(cfg)  # 8 virtual devices -> task mesh
+    assert model.mesh is not None
+    tel = Telemetry(cfg, str(tmp_path))
+    shim = _BuilderShim(cfg, model, tel)
+    monkeypatch.setattr(audit_lib, "audit_system_programs",
+                        lambda *a, **k: _fake_reports([]))
+    bad = [contracts_lib.ContractViolation(
+        "collective_census", "train_step[so=1]", "store gathered"
+    )]
+    seen = {}
+
+    def fake_spmd_audit(cfg_, mesh=None, auditor=None, **kw):
+        seen["mesh"] = mesh
+        return _fake_spmd_reports(bad)
+
+    monkeypatch.setattr(spmd_lib, "audit_spmd_programs", fake_spmd_audit)
+    shim._install_analysis()
+    assert seen["mesh"] is not None  # the SPMD family was audited
+    assert any("1 SPMD program(s)" in m and "1 violation(s)" in m
+               for m in shim.logged)
+    tel.close()
+    log = os.path.join(str(tmp_path), "telemetry.jsonl")
+    from howtotrainyourmamlpytorch_tpu.telemetry import schema
+
+    assert schema.validate_file(log) >= 1
+    recs = [json.loads(line) for line in open(log) if line.strip()]
+    analysis = [r for r in recs if r["kind"] == "analysis"]
+    assert len(analysis) == 1
+    assert analysis[0]["programs"] == 2  # 1 base + 1 SPMD (faked)
+    assert analysis[0]["violations"] == 1
+    assert analysis[0]["mesh"] == "1x8"
+    assert analysis[0]["roofline"]["bound"] == "memory"
+
+    # strict: the SPMD violation fails the build like a base one
+    cfg_strict = make_micro_cfg(batch_size=8, analysis_level="strict")
+    model2 = MAMLFewShotClassifier(cfg_strict)
+    shim2 = _BuilderShim(cfg_strict, model2,
+                         Telemetry(cfg_strict, str(tmp_path)))
+    with pytest.raises(contracts_lib.AuditError, match="store gathered"):
+        shim2._install_analysis()
 
 
 def test_builder_strict_raises_on_violation(monkeypatch, tmp_path):

@@ -108,6 +108,18 @@ def test_bench_prints_parsable_json_line():
     assert "hlo_op_counts" in hc and "fusion" in hc["hlo_op_counts"]
     # CPU has no published MXU peak -> mfu is null, never a bogus number
     assert rec["mfu"] is None
+    # the static roofline model of the timed executable: nominal CPU
+    # peaks (clearly marked), a bound verdict, ranked contributors, and
+    # flops/task agreeing with XLA's own count — the cross-check the
+    # SPMD audit's roofline contract pins (acceptance: within 5%)
+    roof = rec["roofline"]
+    assert roof["nominal_peaks"] is True
+    assert roof["bound"] in ("compute", "memory")
+    assert roof["predicted_hfu"] is not None
+    assert roof["top_contributors"]
+    assert roof["flops_per_task"] == pytest.approx(
+        rec["xla_flops_per_task"], rel=0.05
+    )
     # non-TPU backends run the reduced workload and say so
     assert rec["reduced"] is True
     # the line is self-describing: the exact shapes that produced the number

@@ -190,3 +190,12 @@ def test_analysis_level_validated():
         assert MAMLConfig(analysis_level=level).analysis_level == level
     with pytest.raises(ValueError, match="analysis_level"):
         MAMLConfig(analysis_level="paranoid")
+
+
+def test_hbm_budget_validated():
+    """hbm_budget_gb (the SPMD audit's static per-device memory budget):
+    0 disables, positive values pass, negatives fail by name."""
+    assert MAMLConfig().hbm_budget_gb == 0.0
+    assert MAMLConfig(hbm_budget_gb=16.0).hbm_budget_gb == 16.0
+    with pytest.raises(ValueError, match="hbm_budget_gb"):
+        MAMLConfig(hbm_budget_gb=-1.0)

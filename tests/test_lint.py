@@ -180,6 +180,68 @@ def test_mp004_accepts_retry_routed_io(tmp_path):
     assert lint.lint_file(path) == []
 
 
+# -- MP006: non-owning views over restored/foreign memory --------------------
+
+
+def test_mp006_flags_frombuffer_anywhere(tmp_path):
+    path = _write(tmp_path, "data/bad_view.py", """
+        import numpy as np
+
+        def read_blob(buf):
+            return np.frombuffer(buf, dtype=np.uint8)
+    """)
+    violations = lint.lint_file(path)
+    assert [v.rule for v in violations] == ["MP006"]
+    assert "non-owning view" in violations[0].message
+
+
+def test_mp006_flags_asarray_in_checkpoint_restore_seam(tmp_path):
+    path = _write(tmp_path, "experiment/checkpoint.py", """
+        import numpy as np
+
+        def load_leaf(restored):
+            return np.asarray(restored)
+    """)
+    violations = lint.lint_file(path)
+    assert [v.rule for v in violations] == ["MP006"]
+
+
+def test_mp006_not_armed_for_asarray_outside_restore_seam(tmp_path):
+    """np.asarray elsewhere (metric conversion in the builder/system) is
+    legitimate — a jax.Array's __array__ copies to host; only the
+    checkpoint restore seam aliases foreign-owned capsules."""
+    path = _write(tmp_path, "experiment/builder_helper.py", """
+        import numpy as np
+
+        def summarize(v):
+            return float(np.asarray(v).mean())
+    """)
+    assert lint.lint_file(path) == []
+
+
+def test_mp006_accepts_explicit_owning_copies(tmp_path):
+    path = _write(tmp_path, "experiment/checkpoint.py", """
+        import numpy as np
+
+        def load_leaf(restored, buf):
+            a = np.array(restored)
+            b = np.frombuffer(buf, dtype=np.uint8).copy()
+            c = np.array(np.frombuffer(buf, dtype=np.uint8))
+            return a, b, c
+    """)
+    assert lint.lint_file(path) == []
+
+
+def test_mp006_reasoned_suppression_silences(tmp_path):
+    path = _write(tmp_path, "data/justified_view.py", """
+        import numpy as np
+
+        def peek(buf):
+            return np.frombuffer(buf, np.uint8)  # lint-ok: MP006 read-only view consumed before the mmap closes
+    """)
+    assert lint.lint_file(path) == []
+
+
 # -- MP005: suppressions need reasons ----------------------------------------
 
 

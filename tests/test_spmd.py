@@ -1,0 +1,564 @@
+"""SPMD performance-contract auditor (analysis/spmd.py + roofline.py).
+
+Same three-layer structure as test_analysis.py:
+
+* the canonical program family audits CLEAN under a real 2x4 hybrid
+  (data, task) mesh — sharding, collective-census, HBM-budget and
+  roofline contracts hold on all six programs (the session-scoped
+  ``spmd_audit_reports`` fixture compiles the family once);
+* mutation tests — deliberately break ONE contract per throwaway program
+  (batch sharding dropped, a replicated-store gather forced into the
+  step, the HBM budget shrunk below the static peak, the device-peak
+  table perturbed) and assert exactly that contract fires, no cross-talk;
+* the pure helpers — replica-group parsing (iota + explicit forms),
+  per-axis classification, shape-byte math, census compare semantics
+  (growth fails, shrinkage silent), baseline merge, and the roofline
+  flops cross-check against XLA's own cost analysis (the same figure
+  bench.py records as ``xla_flops_per_task``).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from conftest import make_micro_cfg
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from howtotrainyourmamlpytorch_tpu.analysis import contracts as contracts_lib
+from howtotrainyourmamlpytorch_tpu.analysis import roofline as roofline_lib
+from howtotrainyourmamlpytorch_tpu.analysis import spmd as spmd_lib
+from howtotrainyourmamlpytorch_tpu.core import maml
+
+
+@pytest.fixture(autouse=True)
+def _require_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+
+
+def _contracts_hit(report):
+    return sorted({v.contract for v in report.violations})
+
+
+def _sds(shape, dtype, mesh, tag):
+    return spmd_lib._sharded(
+        jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype)), mesh, tag
+    )
+
+
+# -- the family audits clean under the mesh ----------------------------------
+
+
+def test_spmd_family_has_expected_programs(spmd_audit_reports):
+    names = {r.program for r in spmd_audit_reports}
+    assert names == {
+        "train_step[so=1]",
+        "train_multi_step[so=1,k=2]",
+        "train_step_indexed[so=1]",
+        "train_multi_step_indexed[so=1,k=2]",
+        "eval_multi_step[k=2]",
+        "index_expander",
+    }
+    assert all(r.mesh_spec == "2x4" for r in spmd_audit_reports)
+
+
+def test_spmd_family_audits_clean(spmd_audit_reports):
+    for r in spmd_audit_reports:
+        assert r.ok, f"{r.program}: {[str(v) for v in r.violations]}"
+        assert r.contracts_checked == contracts_lib.SPMD_CONTRACT_NAMES
+
+
+def test_train_steps_reduce_gradients_eval_reduces_metrics(
+    spmd_audit_reports,
+):
+    """The expected collective profile: every train step all-reduces its
+    meta-gradients (classified across the full 2x4 mesh — the global
+    reduce spans both axes), eval all-reduces only its metric means, and
+    the index expander — pure per-shard gather/decode against the
+    replicated store — needs NO collectives at all (the residency
+    claim, now machine-checked)."""
+    by_name = {r.program: r for r in spmd_audit_reports}
+    for name, r in by_name.items():
+        if name.startswith("train"):
+            ar = r.collectives.get("all-reduce", {})
+            assert ar, f"{name}: no gradient all-reduce found"
+            assert set(ar) <= {"both", "ici", "dcn"}
+            assert sum(a["bytes"] for a in ar.values()) > 0
+    assert by_name["index_expander"].collectives == {}
+    eval_colls = by_name["eval_multi_step[k=2]"].collectives
+    assert set(eval_colls) <= {"all-reduce"}
+
+
+def test_spmd_reports_carry_hbm_and_roofline(spmd_audit_reports):
+    for r in spmd_audit_reports:
+        assert r.hbm is not None and r.hbm["peak_bytes"] > 0
+        assert r.roofline is not None
+        assert r.roofline["bound"] in ("compute", "memory")
+        assert r.roofline["predicted_hfu"] is not None
+        assert r.roofline["flops_per_task"] > 0
+        assert r.roofline["top_contributors"], r.program
+
+
+# -- mutation tests: each contract fires alone -------------------------------
+
+
+def _mesh_and_auditor(**kw):
+    cfg = make_micro_cfg(batch_size=8)
+    mesh = spmd_lib.build_audit_mesh(2, 4)
+    return cfg, mesh, spmd_lib.SpmdAuditor(cfg, mesh, **kw)
+
+
+def test_sharding_contract_fires_when_batch_sharding_dropped():
+    """A batch arg audited with its (data, task) sharding dropped — the
+    `global_batch_sharding` placement gone, everything else intact — must
+    trip the sharding contract and nothing else (the collective census
+    SHRINKS in this mutation, which is never a violation)."""
+    cfg, mesh, auditor = _mesh_and_auditor()
+
+    def step(scale, x):
+        return (x * scale).sum()
+
+    scale = _sds((), jnp.float32, mesh, spmd_lib.REPLICATED)
+    x_replicated = _sds((8, 4), jnp.float32, mesh, spmd_lib.REPLICATED)
+    report = auditor.audit(
+        "mutant_unsharded_batch", jax.jit(step), (scale, x_replicated),
+        (spmd_lib.REPLICATED, spmd_lib.BATCH0),
+    )
+    assert _contracts_hit(report) == ["sharding"]
+    assert "not sharded over (data, task)" in report.violations[0].detail
+
+    # the same program with the contract placement audits clean
+    x_sharded = _sds((8, 4), jnp.float32, mesh, spmd_lib.BATCH0)
+    clean = auditor.audit(
+        "sharded_batch", jax.jit(step), (scale, x_sharded),
+        (spmd_lib.REPLICATED, spmd_lib.BATCH0),
+    )
+    assert clean.ok, [str(v) for v in clean.violations]
+
+
+def test_collective_census_fires_on_forced_store_gather():
+    """A replicated uint8 store forced through a task-sharded constraint
+    and gathered inside the step — the exact 'accidental all-gather of
+    the resident store' the SPMD auditor exists to catch — trips the
+    collective census (uint8 data on the interconnect) and only it."""
+    cfg, mesh, auditor = _mesh_and_auditor()
+
+    def bad(store, idx):
+        sharded = jax.lax.with_sharding_constraint(
+            store,
+            NamedSharding(mesh, P(("hosts", "tasks"))),
+        )
+        return sharded[idx]
+
+    store = _sds((64, 8, 8, 1), jnp.uint8, mesh, spmd_lib.REPLICATED)
+    idx = _sds((8, 4), jnp.int32, mesh, spmd_lib.BATCH0)
+    report = auditor.audit(
+        "mutant_store_gather", jax.jit(bad), (store, idx),
+        (spmd_lib.REPLICATED, spmd_lib.BATCH0),
+        expect_replicated_outputs=False,
+        store_bytes=64 * 8 * 8,
+    )
+    assert _contracts_hit(report) == ["collective_census"]
+    assert "uint8" in report.violations[0].detail
+
+    # the clean gather — store replicated all the way, per-shard indexing
+    def good(store, idx):
+        return store[idx]
+
+    clean = auditor.audit(
+        "store_gather_clean", jax.jit(good), (store, idx),
+        (spmd_lib.REPLICATED, spmd_lib.BATCH0),
+        expect_replicated_outputs=False,
+        store_bytes=64 * 8 * 8,
+    )
+    assert clean.ok, [str(v) for v in clean.violations]
+    assert clean.collectives == {}
+
+
+def test_hbm_budget_contract_fires_below_static_peak():
+    """Shrinking hbm_budget_gb below the program's static per-device peak
+    trips the HBM budget contract alone; a budget above it stays clean —
+    and the budget default (0) disables the check entirely."""
+    cfg, mesh, _ = _mesh_and_auditor()
+
+    def step(scale, x):
+        return (x * scale).sum()
+
+    scale = _sds((), jnp.float32, mesh, spmd_lib.REPLICATED)
+    x = _sds((8, 64), jnp.float32, mesh, spmd_lib.BATCH0)
+    args = (scale, x)
+    tags = (spmd_lib.REPLICATED, spmd_lib.BATCH0)
+
+    tight = spmd_lib.SpmdAuditor(cfg, mesh, hbm_budget_gb=1e-9)
+    report = tight.audit("mutant_oom", jax.jit(step), args, tags)
+    assert _contracts_hit(report) == ["hbm_budget"]
+    assert "exceeds hbm_budget_gb" in report.violations[0].detail
+
+    roomy = spmd_lib.SpmdAuditor(cfg, mesh, hbm_budget_gb=16.0)
+    assert roomy.audit("fits", jax.jit(step), args, tags).ok
+    disabled = spmd_lib.SpmdAuditor(cfg, mesh, hbm_budget_gb=0.0)
+    assert disabled.audit("off", jax.jit(step), args, tags).ok
+
+
+def test_roofline_contract_fires_on_perturbed_peak_table():
+    """A device-peak table with a zeroed/broken entry for the current
+    backend must fail the roofline cross-check — and ONLY it: the same
+    program under the stock table audits clean."""
+    cfg, mesh, _ = _mesh_and_auditor()
+
+    def step(scale, x):
+        return (x * scale).sum()
+
+    scale = _sds((), jnp.float32, mesh, spmd_lib.REPLICATED)
+    x = _sds((8, 16), jnp.float32, mesh, spmd_lib.BATCH0)
+    args = (scale, x)
+    tags = (spmd_lib.REPLICATED, spmd_lib.BATCH0)
+
+    bad_peaks = [{
+        "kind": "cpu", "flops": {"float32": 0.0},
+        "hbm_bytes_per_s": 0.0, "nominal": True,
+    }]
+    perturbed = spmd_lib.SpmdAuditor(cfg, mesh, peaks=bad_peaks)
+    report = perturbed.audit("mutant_peaks", jax.jit(step), args, tags)
+    assert _contracts_hit(report) == ["roofline"]
+    assert "device-peak table" in report.violations[0].detail
+
+    stock = spmd_lib.SpmdAuditor(cfg, mesh)
+    assert stock.audit("stock_peaks", jax.jit(step), args, tags).ok
+
+
+def test_collective_census_regression_fires_and_shrink_does_not(
+    spmd_micro_cfg, spmd_audit_reports,
+):
+    """Mesh-keyed baseline semantics: a pinned census with FEWER
+    collective bytes/counts than the program flags a regression; a pinned
+    census with MORE (the program improved) stays silent."""
+    import dataclasses
+
+    fingerprint = contracts_lib.config_fingerprint(
+        dataclasses.asdict(spmd_micro_cfg)
+    )
+    train = next(
+        r for r in spmd_audit_reports if r.program == "train_step[so=1]"
+    )
+
+    def baseline_with(collectives):
+        return {
+            "version": 1,
+            "jax": jax.__version__,
+            "backend": "cpu",
+            "config_fingerprint": fingerprint,
+            "programs": {
+                contracts_lib.spmd_census_key(
+                    "train_step[so=1]", "cpu", "2x4"
+                ): {"census": {}, "collectives": collectives},
+            },
+        }
+
+    shrunk = {
+        op: {
+            axis: {"count": max(0, s["count"] - 1),
+                   "bytes": max(0, s["bytes"] - 1)}
+            for axis, s in by_axis.items()
+        }
+        for op, by_axis in train.collectives.items()
+    }
+    grown = {
+        op: {
+            axis: {"count": s["count"] + 5, "bytes": s["bytes"] + 4096}
+            for axis, s in by_axis.items()
+        }
+        for op, by_axis in train.collectives.items()
+    }
+
+    mesh = spmd_lib.build_audit_mesh(2, 4)
+    regressed = spmd_lib.SpmdAuditor(
+        spmd_micro_cfg, mesh, baseline=baseline_with(shrunk),
+        config_fingerprint=fingerprint,
+    )
+    reports = spmd_lib.audit_spmd_programs(
+        spmd_micro_cfg, mesh=mesh, auditor=regressed,
+        programs=["train_step[so=1]"],
+    )
+    assert _contracts_hit(reports[0]) == ["collective_census"]
+    assert "regression" in reports[0].violations[0].detail
+
+    improved = spmd_lib.SpmdAuditor(
+        spmd_micro_cfg, mesh, baseline=baseline_with(grown),
+        config_fingerprint=fingerprint,
+    )
+    reports = spmd_lib.audit_spmd_programs(
+        spmd_micro_cfg, mesh=mesh, auditor=improved,
+        programs=["train_step[so=1]"],
+    )
+    assert reports[0].ok, [str(v) for v in reports[0].violations]
+
+
+# -- pure helpers ------------------------------------------------------------
+
+
+def test_parse_mesh_spec():
+    assert spmd_lib.parse_mesh_spec("1x8") == (1, 8)
+    assert spmd_lib.parse_mesh_spec("2X4") == (2, 4)
+    for bad in ("8", "0x8", "2x0", "ax8", "2x4x2", ""):
+        with pytest.raises(ValueError, match="mesh spec"):
+            spmd_lib.parse_mesh_spec(bad)
+
+
+def test_hlo_shape_bytes():
+    assert contracts_lib.hlo_shape_bytes("f32[8,4]") == 128
+    assert contracts_lib.hlo_shape_bytes("f32[8,4]{1,0}") == 128
+    assert contracts_lib.hlo_shape_bytes("bf16[10]") == 20
+    assert contracts_lib.hlo_shape_bytes("u8[64,8,8,1]") == 4096
+    assert contracts_lib.hlo_shape_bytes("f32[]") == 4
+    assert contracts_lib.hlo_shape_bytes("(f32[2]{0}, u8[4]{0})") == 12
+    assert contracts_lib.hlo_shape_bytes("pred[16]") == 16
+
+
+def test_parse_replica_groups_iota_and_explicit():
+    # [2,4]<=[8]: ids 0..7 in 2 groups of 4 (per-row / ICI)
+    assert contracts_lib.parse_replica_groups(
+        ", replica_groups=[2,4]<=[8], use_global_device_ids=true"
+    ) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # [4,2]<=[2,4]T(1,0): transpose -> per-column (DCN) groups
+    assert contracts_lib.parse_replica_groups(
+        ", replica_groups=[4,2]<=[2,4]T(1,0)"
+    ) == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    # one global group
+    assert contracts_lib.parse_replica_groups(
+        ", replica_groups=[1,8]<=[8]"
+    ) == [[0, 1, 2, 3, 4, 5, 6, 7]]
+    # explicit form
+    assert contracts_lib.parse_replica_groups(
+        ", replica_groups={{0,1},{2,3}}, to_apply=%add"
+    ) == [[0, 1], [2, 3]]
+    assert contracts_lib.parse_replica_groups(", to_apply=%add") is None
+
+
+def test_classify_replica_groups():
+    classify = contracts_lib.classify_replica_groups
+    # 2x4 mesh: rows = data (DCN), columns within a row = task (ICI)
+    assert classify([[0, 1, 2, 3], [4, 5, 6, 7]], 2, 4) == "ici"
+    assert classify([[0, 4], [1, 5], [2, 6], [3, 7]], 2, 4) == "dcn"
+    assert classify([[0, 1, 2, 3, 4, 5, 6, 7]], 2, 4) == "both"
+    assert classify(None, 2, 4) == "unknown"
+    # degenerate meshes: singleton groups still classify by the only axis
+    assert classify([[0], [1]], 1, 8) == "ici"
+    assert classify([[0], [1]], 8, 1) == "dcn"
+
+
+def test_compare_collective_census_semantics():
+    pinned = {"all-reduce": {"ici": {"count": 2, "bytes": 100}}}
+    same = contracts_lib.compare_collective_census(
+        {"all-reduce": {"ici": {"count": 2, "bytes": 100}}}, pinned
+    )
+    assert same == []
+    shrink = contracts_lib.compare_collective_census(
+        {"all-reduce": {"ici": {"count": 1, "bytes": 50}}}, pinned
+    )
+    assert shrink == []
+    grow = contracts_lib.compare_collective_census(
+        {"all-reduce": {"ici": {"count": 3, "bytes": 100}}}, pinned
+    )
+    assert grow and "count: 2 -> 3" in grow[0]
+    new_axis = contracts_lib.compare_collective_census(
+        {"all-gather": {"dcn": {"count": 1, "bytes": 8}}}, pinned
+    )
+    assert len(new_axis) == 2  # count 0->1 and bytes 0->8
+
+
+def test_save_baseline_merges_mesh_and_plain_entries(tmp_path):
+    """`cli audit --pin` and `cli audit --mesh RxC --pin` compose: pinning
+    mesh entries preserves the plain program entries (same jax/backend/
+    fingerprint) instead of clobbering them — and vice versa."""
+    path = str(tmp_path / "CONTRACTS.json")
+
+    def rep(program, collectives=None):
+        r = contracts_lib.SpmdAuditReport(
+            program=program, backend="cpu",
+            contracts_checked=contracts_lib.SPMD_CONTRACT_NAMES,
+            census={"dot": 3},
+        ) if collectives is not None else contracts_lib.AuditReport(
+            program=program, backend="cpu",
+            contracts_checked=contracts_lib.CONTRACT_NAMES,
+            census={"dot": 3},
+        )
+        if collectives is not None:
+            r.collectives = collectives
+        return r
+
+    contracts_lib.save_baseline(
+        path, jax_version=jax.__version__, backend="cpu",
+        config_fingerprint="f00d", reports=[rep("train_step[so=1]")],
+    )
+    colls = {"all-reduce": {"ici": {"count": 1, "bytes": 64}}}
+    data = contracts_lib.save_baseline(
+        path, jax_version=jax.__version__, backend="cpu",
+        config_fingerprint="f00d",
+        reports=[rep("train_step[so=1]", colls)],
+        mesh_spec="1x8",
+    )
+    assert set(data["programs"]) == {
+        "train_step[so=1]@cpu", "train_step[so=1]@cpu@1x8",
+    }
+    assert data["programs"]["train_step[so=1]@cpu@1x8"][
+        "collectives"
+    ] == colls
+    # a FOREIGN prior baseline (different fingerprint) is replaced whole
+    data = contracts_lib.save_baseline(
+        path, jax_version=jax.__version__, backend="cpu",
+        config_fingerprint="0ther", reports=[rep("train_step[so=1]")],
+    )
+    assert set(data["programs"]) == {"train_step[so=1]@cpu"}
+
+
+# -- roofline ----------------------------------------------------------------
+
+
+def test_roofline_flops_per_task_matches_cost_analysis(micro_cfg):
+    """The cross-check the acceptance criterion pins: the roofline's
+    flops/task must agree with XLA's own cost analysis of the same
+    executable — the figure bench.py records as ``xla_flops_per_task`` —
+    within 5% (they derive from the same surface, so in practice
+    exactly)."""
+    from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
+
+    state = audit_lib._state_avals(micro_cfg)
+    batch = audit_lib._batch_avals(micro_cfg)
+    weights = jax.ShapeDtypeStruct((2,), jnp.float32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    step = jax.jit(
+        maml.make_train_step(micro_cfg, second_order=True),
+        donate_argnums=maml.TRAIN_DONATE,
+    )
+    compiled = step.trace(state, *batch, weights, lr).lower().compile()
+    ca = contracts_lib.cost_analysis_dict(compiled)
+    xla_flops_per_task = float(ca["flops"]) / micro_cfg.batch_size
+    report = roofline_lib.roofline_report(
+        compiled,
+        device_kind=jax.devices()[0].device_kind,
+        dtype=micro_cfg.compute_dtype,
+        tasks=micro_cfg.batch_size,
+    )
+    assert report["flops_per_task"] == pytest.approx(
+        xla_flops_per_task, rel=0.05
+    )
+    # the agreement IS the contract: verify_roofline passes with the
+    # recorded figure and fails against a figure 20% off
+    assert roofline_lib.verify_roofline(
+        report, "train_step", reference_flops_per_task=xla_flops_per_task
+    ) == []
+    bad = roofline_lib.verify_roofline(
+        report, "train_step",
+        reference_flops_per_task=xla_flops_per_task * 1.2,
+    )
+    assert bad and bad[0].contract == "roofline"
+    assert "disagrees" in bad[0].detail
+
+
+def test_roofline_decomposition_ranks_real_work(micro_cfg):
+    """The decomposition covers most of the cost-analysis flops (dot +
+    elementwise recovery), ranks contributors by predicted time with
+    shares summing to ~1, and excludes free aliasing ops."""
+    def f(x, w):
+        y = jnp.tanh(x @ w)
+        return (y * y).sum()
+
+    compiled = (
+        jax.jit(f)
+        .trace(
+            jax.ShapeDtypeStruct((64, 64), jnp.float32),
+            jax.ShapeDtypeStruct((64, 64), jnp.float32),
+        )
+        .lower()
+        .compile()
+    )
+    report = roofline_lib.roofline_report(
+        compiled, device_kind="cpu", dtype="float32", tasks=1,
+    )
+    assert report["nominal_peaks"] is True
+    assert 0.5 < report["flops_coverage"] < 2.0
+    tops = report["top_contributors"]
+    assert tops == sorted(tops, key=lambda c: c["seconds"], reverse=True)
+    assert all(c["op"] not in roofline_lib._FREE_OPS for c in tops)
+    assert sum(c["time_share"] for c in tops) <= 1.001
+    # the dot carries essentially all recovered flops
+    census = roofline_lib.op_cost_census(compiled.as_text())
+    assert census["dot"]["flops"] >= 2 * 64 * 64 * 64
+
+
+def test_peak_flops_nominal_entries_are_not_quotable():
+    """bench.py's quoted MFU denominator: real TPU entries resolve, the
+    nominal CPU entry and unknown hardware return None."""
+    assert roofline_lib.peak_flops("TPU v5 lite", "bfloat16") == 197e12
+    assert roofline_lib.peak_flops("TPU v5p", "float32") == 153e12
+    assert roofline_lib.peak_flops("cpu", "float32") is None
+    assert roofline_lib.peak_flops("TPU v99", "bfloat16") is None
+    # the roofline itself still finds the nominal entry
+    assert roofline_lib.find_peak_entry("cpu")["nominal"] is True
+
+
+# -- cli audit --mesh --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_audit_mesh_end_to_end(tmp_path, spmd_micro_cfg, capsys):
+    """`cli audit --mesh 2x4 --pin` writes mesh-keyed entries; the
+    follow-up `--mesh 2x4 --json` compares clean against them, reports
+    collectives + hbm + roofline per program, and exits 0."""
+    import dataclasses
+
+    from howtotrainyourmamlpytorch_tpu.tools import audit_cli
+
+    cfg_path = tmp_path / "audit_cfg.json"
+    with open(cfg_path, "w") as f:
+        json.dump(dataclasses.asdict(spmd_micro_cfg), f)
+    contracts_path = tmp_path / "CONTRACTS.json"
+    rc = audit_cli.main([
+        "--config", str(cfg_path), "--contracts", str(contracts_path),
+        "--mesh", "2x4", "--pin",
+    ])
+    assert rc == 0
+    pinned = contracts_lib.load_baseline(str(contracts_path))
+    assert pinned is not None and len(pinned["programs"]) == 6
+    assert all(key.endswith("@2x4") for key in pinned["programs"])
+    capsys.readouterr()
+    rc = audit_cli.main([
+        "--config", str(cfg_path), "--contracts", str(contracts_path),
+        "--mesh", "2x4", "--json", "--hbm-budget-gb", "8",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert payload["mesh"] == "2x4"
+    for name, prog in payload["programs"].items():
+        assert prog["ok"], (name, prog["violations"])
+        assert prog["hbm"]["peak_bytes"] > 0
+        assert prog["roofline"]["bound"] in ("compute", "memory")
+    train = payload["programs"]["train_step[so=1]"]
+    assert train["collectives"]["all-reduce"]
+    # an impossible budget makes the same audit fail with exit code 1
+    rc = audit_cli.main([
+        "--config", str(cfg_path), "--contracts", str(contracts_path),
+        "--mesh", "2x4", "--hbm-budget-gb", "1e-9",
+    ])
+    assert rc == 1
+
+
+def test_pinned_repo_baseline_has_mesh_entries():
+    """CONTRACTS.json at the repo root carries the 1x8 mesh-keyed SPMD
+    entries next to the six single-device ones (the `cli audit --mesh
+    1x8` CI gate compares against them)."""
+    baseline = contracts_lib.load_baseline()
+    assert baseline is not None, "CONTRACTS.json missing at the repo root"
+    mesh_keys = [k for k in baseline["programs"] if k.endswith("@1x8")]
+    plain_keys = [k for k in baseline["programs"] if "@" not in k.replace(
+        "@cpu", "", 1
+    )]
+    assert len(mesh_keys) == 6
+    assert len(plain_keys) == 6
+    train_key = contracts_lib.spmd_census_key(
+        "train_step[so=1]", "cpu", "1x8"
+    )
+    assert "collectives" in baseline["programs"][train_key]

@@ -606,6 +606,40 @@ def test_v4_retrace_record_kind_validates():
         })
 
 
+def test_validate_file_accepts_v4_era_fixture():
+    """The pinned v4-era log (written before the v5 `analysis` kind
+    existed) validates unchanged under the v5 validator — the backward
+    half of the version contract: v5 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v4_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 6
+
+
+def test_v5_analysis_record_kind_validates():
+    """The schema v5 addition: an `analysis` record (the build-time audit
+    summary incl. the SPMD mesh and roofline payload) built through the
+    sink's make_record passes strict validation; one missing its required
+    fields is rejected."""
+    tel.validate_record(tel.make_record(
+        "analysis", programs=12, violations=0, mesh="1x8",
+        roofline={
+            "program": "train_step[so=1]", "bound": "memory",
+            "predicted_hfu": 0.24, "predicted_mfu": None,
+            "flops_per_task": 2.7e6,
+        },
+    ))
+    # single-device runs carry no mesh/roofline — still valid
+    tel.validate_record(tel.make_record(
+        "analysis", programs=6, violations=1, mesh=None, roofline=None,
+    ))
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "analysis",
+            "programs": 6,
+        })
+
+
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------
 
 

@@ -247,6 +247,41 @@ def test_summary_and_anomalies_surface_retraces(tmp_path, capsys):
     assert "ab12cd34ef560078" in out
 
 
+def test_summary_surfaces_audit_and_roofline_line(tmp_path, capsys):
+    """Schema v5: `summary` surfaces the build-time audit record — the
+    program/violation counts, the SPMD audit mesh and the flagship
+    roofline prediction — as the audit line (still jax-free)."""
+    records = _run_records([0.5])
+    records.insert(-1, make_record(
+        "analysis", programs=12, violations=0, mesh="1x8",
+        roofline={
+            "program": "train_step[so=1]", "bound": "memory",
+            "predicted_hfu": 0.24, "predicted_mfu": 0.031,
+            "flops_per_task": 2.7e6,
+        },
+    ))
+    log = _write_log(tmp_path / "t.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["audit"]["programs"] == 12
+    assert payload["audit"]["mesh"] == "1x8"
+    assert payload["audit"]["roofline"]["bound"] == "memory"
+    assert cli_main(["summary", log]) == 0
+    out = capsys.readouterr().out
+    assert "audit: 12 program(s), 0 violation(s) on mesh 1x8" in out
+    assert "roofline[train_step[so=1]]: memory-bound" in out
+    assert "predicted mfu 0.031" in out
+
+
+def test_summary_without_audit_record_omits_audit_line(tmp_path, capsys):
+    records = _run_records([0.5])
+    log = _write_log(tmp_path / "t.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["audit"] is None
+    assert cli_main(["summary", log]) == 0
+    assert "audit:" not in capsys.readouterr().out
+
+
 def test_summary_without_retraces_prints_no_analysis_line(tmp_path, capsys):
     log = _write_log(tmp_path / "t.jsonl", _run_records([0.5]))
     assert cli_main(["summary", log]) == 0
