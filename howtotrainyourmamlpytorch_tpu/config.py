@@ -185,6 +185,17 @@ class MAMLConfig:
     # exclude CIFAR (its per-image RNG crop/flip can't be vectorized on
     # device); bit-exact with the host path by construction (tested).
     data_placement: str = "host"  # 'host' | 'uint8_stream' | 'device'
+    # residency layout of the data_placement='device' uint8 stores on a
+    # multi-host mesh: 'replicated' (default) uploads the full store to
+    # every device; 'hosts' shards the store's row axis over the mesh's
+    # host (DCN) axis — per-host HBM drops to store/n_hosts and the
+    # on-device gather becomes a masked local gather + a hosts-axis psum
+    # of the *decoded batch* (exactly one shard contributes per row, so
+    # the sum is bit-exact with the replicated gather; the collective is
+    # batch-sized float32, never store-sized and never uint8 — the PR 8
+    # SPMD invariants hold by construction). Single-host meshes have no
+    # host axis and degrade to 'replicated' with a log line.
+    store_sharding: str = "replicated"  # 'replicated' | 'hosts'
     # outer-loop updates fused into ONE device dispatch (lax.scan over
     # stacked batches). >1 amortizes per-dispatch host round-trips — vital
     # over networked device transports (remote-TPU tunnel: ~0.5s/dispatch
@@ -304,6 +315,20 @@ class MAMLConfig:
     # history) and exits with resilience.PREEMPT_EXIT_CODE. false keeps
     # the process's default signal behaviour (die, lose up to an epoch).
     handle_preemption_signals: bool = True
+    # coordinated drain (resilience/elastic.py, multi-process runs): when
+    # ONE worker is signalled, the primary publishes a drain commit at
+    # `its iter + drain_margin_iters`, and every process trains up to that
+    # iteration before the COLLECTIVE emergency checkpoint — the margin
+    # must cover host-loop skew (~1 dispatch) plus one boundary poll of
+    # shared-filesystem propagation. Single-process runs drain at the next
+    # boundary as before and never consult this.
+    drain_margin_iters: int = 4
+    # bound on the collective checkpoint path's cross-process barriers
+    # (experiment/checkpoint.py): a gang member that dies mid-save turns
+    # into CheckpointBarrierTimeoutError on the survivors after this many
+    # seconds, naming the primary's expected swap path, instead of the
+    # former unbounded spin-wait.
+    ckpt_follower_timeout_s: float = 600.0
 
     # --- static analysis (analysis/) --------------------------------------
     # program-contract audits + runtime retrace detection:
@@ -453,6 +478,27 @@ class MAMLConfig:
                     "from the flat uint8 image store that only the mmap "
                     "cache builds (data/preprocess.py)"
                 )
+        if self.store_sharding not in ("replicated", "hosts"):
+            raise ValueError(
+                f"store_sharding must be 'replicated' or 'hosts', got "
+                f"{self.store_sharding!r}"
+            )
+        if self.store_sharding == "hosts" and self.data_placement != "device":
+            raise ValueError(
+                "store_sharding='hosts' only applies to the resident-store "
+                "tier (data_placement='device'); the other placements keep "
+                "no device store to shard"
+            )
+        if self.drain_margin_iters < 1:
+            raise ValueError(
+                f"drain_margin_iters must be >= 1, got "
+                f"{self.drain_margin_iters}"
+            )
+        if self.ckpt_follower_timeout_s <= 0:
+            raise ValueError(
+                f"ckpt_follower_timeout_s must be > 0, got "
+                f"{self.ckpt_follower_timeout_s}"
+            )
         if self.telemetry_level not in ("off", "scalars", "dynamics"):
             raise ValueError(
                 f"telemetry_level must be 'off', 'scalars' or 'dynamics', "

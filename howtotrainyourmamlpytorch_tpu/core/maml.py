@@ -580,12 +580,20 @@ def make_eval_step(cfg: MAMLConfig, decode_uint8: Optional[bool] = None):
 # ``augment_stack`` gate.
 
 
-def make_train_step_indexed(cfg: MAMLConfig, second_order: bool, augment: bool):
+def make_train_step_indexed(cfg: MAMLConfig, second_order: bool, augment: bool,
+                            store_mesh=None):
     """Signature: (state, store, gather, rot_k, loss_weights, lr) ->
     (state, metrics) — ``make_train_step`` with the on-device episode
-    expansion in front; identical math to the host pixel path."""
+    expansion in front; identical math to the host pixel path.
+
+    ``store_mesh`` (elastic sharded-store tier, ``store_sharding='hosts'``)
+    switches the expansion to the masked-gather + host-axis-psum form for a
+    store whose row axis is sharded over that mesh's host axis — bit-exact
+    with the replicated gather by construction (ops/device_pipeline.py)."""
     step = make_train_step(cfg, second_order, decode_uint8=False)
-    expand = device_pipeline.make_index_expander(cfg, augment)
+    expand = device_pipeline.make_index_expander(
+        cfg, augment, store_mesh=store_mesh
+    )
 
     def train_step(state: MetaState, store, gather, rot_k, loss_weights, lr):
         x_s, y_s, x_t, y_t = expand(store, gather, rot_k)
@@ -595,13 +603,13 @@ def make_train_step_indexed(cfg: MAMLConfig, second_order: bool, augment: bool):
 
 
 def make_train_multi_step_indexed(
-    cfg: MAMLConfig, second_order: bool, augment: bool
+    cfg: MAMLConfig, second_order: bool, augment: bool, store_mesh=None
 ):
     """The ``steps_per_dispatch`` twin of ``make_train_step_indexed``: scan
     over a leading k axis of (gather, rot_k) — the resident store is a scan
     invariant, NOT scanned over, so K fused updates still upload only K·(a
     few KB) of indices."""
-    step = make_train_step_indexed(cfg, second_order, augment)
+    step = make_train_step_indexed(cfg, second_order, augment, store_mesh)
 
     def multi_step(state, store, gather, rot_k, loss_weights, lr):
         def body(st, batch):
@@ -614,11 +622,14 @@ def make_train_multi_step_indexed(
     return multi_step
 
 
-def make_eval_step_indexed(cfg: MAMLConfig, augment: bool = False):
+def make_eval_step_indexed(cfg: MAMLConfig, augment: bool = False,
+                           store_mesh=None):
     """Signature: (state, store, gather, rot_k) -> (metrics, preds) — the
     evaluation twin of ``make_train_step_indexed``."""
     step = make_eval_step(cfg, decode_uint8=False)
-    expand = device_pipeline.make_index_expander(cfg, augment)
+    expand = device_pipeline.make_index_expander(
+        cfg, augment, store_mesh=store_mesh
+    )
 
     def eval_step(state: MetaState, store, gather, rot_k):
         x_s, y_s, x_t, y_t = expand(store, gather, rot_k)
@@ -628,11 +639,12 @@ def make_eval_step_indexed(cfg: MAMLConfig, augment: bool = False):
 
 
 def make_eval_multi_step_indexed(
-    cfg: MAMLConfig, with_preds: bool = False, augment: bool = False
+    cfg: MAMLConfig, with_preds: bool = False, augment: bool = False,
+    store_mesh=None,
 ):
     """The ``eval_batches_per_dispatch`` twin of ``make_eval_step_indexed``
     (same stacked-metrics/preds contract as ``make_eval_multi_step``)."""
-    step = make_eval_step_indexed(cfg, augment)
+    step = make_eval_step_indexed(cfg, augment, store_mesh)
 
     def multi_eval(state: MetaState, store, gather, rot_k):
         def body(st, batch):

@@ -30,7 +30,7 @@ from typing import Dict, Iterator, NamedTuple, Optional, Tuple, Union
 import numpy as np
 
 from ..config import MAMLConfig
-from ..resilience import faults
+from ..resilience import elastic, faults
 from . import datasets as ds
 from .episodes import Episode, IndexEpisode, sample_episode, sample_episode_indices
 
@@ -189,12 +189,21 @@ class MetaLearningDataLoader:
     seeds are computed from *global* task indices, so the union of all hosts'
     slices is bit-identical to a single-host run — the TPU-native analogue of
     the reference's DataLoader-feeds-DataParallel layout (data.py:580).
+
+    Elastic resume: the episode->process assignment is the pure block
+    partition of ``resilience/elastic.py`` (never derived from device
+    enumeration), and the experiment state checkpoints a *global* episode
+    cursor that ``__init__`` consumes (``episode_cursor=``) — so a run
+    resumed on a DIFFERENT process count replays the identical global
+    episode sequence, merely re-partitioned (validated against the
+    iteration-derived value to catch global-batch-size drift).
     """
 
     def __init__(self, cfg: MAMLConfig, current_iter: int = 0,
                  cache_dir: Optional[str] = None,
                  shard_id: Optional[int] = None,
-                 num_shards: Optional[int] = None):
+                 num_shards: Optional[int] = None,
+                 episode_cursor: Optional[int] = None):
         self.cfg = cfg
         self.tasks_per_batch = cfg.global_tasks_per_batch
         if num_shards is None:
@@ -206,12 +215,15 @@ class MetaLearningDataLoader:
             shard_id = jax.process_index()
         self.shard_id = shard_id or 0
         self.num_shards = max(1, num_shards)
-        if self.tasks_per_batch % self.num_shards != 0:
-            raise ValueError(
-                f"tasks per batch {self.tasks_per_batch} not divisible by "
-                f"{self.num_shards} hosts"
-            )
-        self.tasks_per_shard = self.tasks_per_batch // self.num_shards
+        # the topology-invariant partition (resilience/elastic.py): this
+        # process's contiguous block of every global batch — a pure
+        # function of (tasks_per_batch, shard_id, num_shards), so a resume
+        # on a different process count re-partitions the SAME global
+        # episode sequence instead of silently changing it
+        self._shard_lo, self._shard_hi = elastic.shard_slice(
+            self.tasks_per_batch, self.shard_id, self.num_shards
+        )
+        self.tasks_per_shard = self._shard_hi - self._shard_lo
         self.dataset = FewShotEpisodicDataset(cfg, cache_dir)
         self.total_train_iters_produced = 0
         # input-pipeline telemetry (bench.py `input_pipeline` + the per-epoch
@@ -232,7 +244,30 @@ class MetaLearningDataLoader:
         # for good, and the consumer must fail loudly rather than block on
         # an empty queue until the watchdog fires
         self._producer_error: Optional[BaseException] = None
-        self.continue_from_iter(current_iter)
+        if episode_cursor is not None:
+            # the checkpointed GLOBAL episode cursor is authoritative: a
+            # mismatch with the iteration-derived value means the global
+            # batch size changed between the run that wrote the checkpoint
+            # and this one — the deterministic stream cannot be continued
+            # equivalently, so fail loudly instead of training on a
+            # silently different episode sequence
+            derived = elastic.episode_cursor_for_iter(
+                current_iter, self.tasks_per_batch
+            )
+            if int(episode_cursor) != derived:
+                raise ValueError(
+                    f"checkpointed episode cursor {int(episode_cursor)} does "
+                    f"not equal current_iter * tasks_per_batch = "
+                    f"{current_iter} * {self.tasks_per_batch} = {derived}; "
+                    "the global meta-batch size changed since the "
+                    "checkpoint was written, which breaks deterministic "
+                    "episode-stream resume (restore the original "
+                    "batch_size/num_of_gpus/samples_per_iter, or restart "
+                    "from_scratch)"
+                )
+            self.total_train_iters_produced += int(episode_cursor)
+        else:
+            self.continue_from_iter(current_iter)
 
     def pop_stream_stats(self) -> Dict[str, float]:
         """Return and reset the cumulative producer telemetry."""
@@ -285,8 +320,9 @@ class MetaLearningDataLoader:
         stop = threading.Event()
         build, stack = self._episode_builder(set_name, augment)
 
-        lo = self.shard_id * self.tasks_per_shard
-        hi = lo + self.tasks_per_shard
+        # this process's block of every global batch — the topology-
+        # invariant partition computed once in __init__ (elastic.shard_slice)
+        lo, hi = self._shard_lo, self._shard_hi
 
         def put(item) -> bool:
             # timed/poll put, NOT a bare out.put(): when the consumer

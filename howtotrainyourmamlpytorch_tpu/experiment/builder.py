@@ -35,9 +35,11 @@ import numpy as np
 from ..config import MAMLConfig
 from ..resilience import (
     PREEMPT_EXIT_CODE,
+    DrainCoordinator,
     PreemptedError,
     RetriesExhaustedError,
     RetryPolicy,
+    elastic,
     faults,
 )
 from ..telemetry import FlightRecorder, HealthMonitor, Telemetry, Watchdog
@@ -171,11 +173,17 @@ class ExperimentBuilder:
                 self.state["current_iter"] // cfg.total_iter_per_epoch
             )
         # data stream fast-forwarded to the resume point
-        # (experiment_builder.py:53)
+        # (experiment_builder.py:53): the checkpointed GLOBAL episode
+        # cursor (resilience/elastic.py) is handed to the loader, which
+        # validates it against the iteration-derived value — a resume on a
+        # different process count replays the identical global episode
+        # sequence, re-partitioned (old checkpoints without the key fall
+        # back to the derived cursor)
         self.data = data_loader_cls(
             cfg,
             current_iter=self.state["current_iter"],
             cache_dir=cfg.cache_dir or self.logs_filepath,
+            episode_cursor=self.state.get("episode_cursor"),
         )
         if cfg.data_placement == "device":
             # hand the model the per-set flat uint8 stores so it can make
@@ -207,6 +215,27 @@ class ExperimentBuilder:
         import jax
 
         self.is_primary = jax.process_index() == 0
+        # coordinated preemption drain (resilience/elastic.py): in
+        # multi-process runs ONE worker's SIGTERM must drain EVERY process
+        # at the same iteration (the emergency checkpoint is collective).
+        # The coordination directory lives in the experiment dir — the
+        # shared-filesystem rendezvous the collective checkpoints already
+        # rely on. Single-process runs keep the immediate drain-at-next-
+        # boundary behaviour and never touch this.
+        self._drain_coordinator: Optional[DrainCoordinator] = None
+        self._drain_commit_logged = False
+        if jax.process_count() > 1:
+            self._drain_coordinator = DrainCoordinator(
+                os.path.join(self.logs_filepath, "elastic"),
+                jax.process_index(),
+                jax.process_count(),
+                margin_iters=cfg.drain_margin_iters,
+                # run-scoped: every process derives the same tag from the
+                # same resumed checkpoint, so a previous incarnation's
+                # consumed (or crash-stranded) drain files cannot preempt
+                # this run
+                run_tag=f"i{int(self.state['current_iter'])}",
+            )
         if not self.create_summary_csv:
             # resumed: drop CSV rows from epochs beyond the checkpoint — a
             # killed run can have appended the row for an epoch whose
@@ -230,6 +259,29 @@ class ExperimentBuilder:
             # two runs' logs explain their own divergence
             config=dataclasses.asdict(cfg),
         )
+        # elastic resume record (schema v6): a checkpoint written by a
+        # different topology resumes deterministically — say so in the log
+        # (old -> new process count + the episode-cursor re-entry point)
+        saved_pc = self.state.get("process_count")
+        if saved_pc is not None and int(self.state["current_iter"]) > 0:
+            cursor = elastic.episode_cursor_for_iter(
+                int(self.state["current_iter"]), cfg.global_tasks_per_batch
+            )
+            self.telemetry.event(
+                "elastic",
+                event="resume",
+                old_process_count=int(saved_pc),
+                new_process_count=int(jax.process_count()),
+                iter=int(self.state["current_iter"]),
+                episode_cursor=int(cursor),
+            )
+            if int(saved_pc) != jax.process_count():
+                self._log(
+                    f"[elastic] resuming a checkpoint written by "
+                    f"{int(saved_pc)} process(es) on {jax.process_count()} "
+                    f"process(es): global episode cursor {cursor} "
+                    "re-partitioned over the new topology"
+                )
         # training-health monitor: host-side ring of recent step health
         # (flight recorder) + anomaly detection over the on-device probes
         # (health_level='monitor'|'halt'), dumping ring + state to
@@ -570,6 +622,86 @@ class ExperimentBuilder:
         except OSError:
             pass  # hygiene only — never load-bearing
 
+    def _stamp_elastic_state(self) -> None:
+        """Stamp the topology-portable resume keys into the experiment
+        state just before any checkpoint write: the GLOBAL episode cursor
+        (a pure function of the iteration and the global batch size —
+        resilience/elastic.py) and the process count that wrote the
+        checkpoint. A resume on a different host count re-enters the
+        episode stream at exactly the cursor and logs the topology
+        change."""
+        import jax
+
+        self.state["episode_cursor"] = elastic.episode_cursor_for_iter(
+            int(self.state["current_iter"]), self.cfg.global_tasks_per_batch
+        )
+        self.state["process_count"] = int(jax.process_count())
+
+    def _check_drain(self) -> None:
+        """The dispatch-boundary preemption check. Single-process: a
+        latched SIGTERM/SIGINT drains immediately (PR 6 behaviour).
+        Multi-process: the latch only *publishes a drain request*; every
+        process keeps training until the primary's drain commit names an
+        iteration all processes can reach, then drains THERE — so the
+        collective emergency checkpoint sees every process at the same
+        step and is written exactly once (resilience/elastic.py)."""
+        coordinator = self._drain_coordinator
+        if coordinator is None:
+            if self._preempt_signum is not None:
+                self._preempt_exit()
+            return
+        import jax
+
+        it = int(self.state["current_iter"])
+        if self._preempt_signum is not None:
+            if coordinator.request_drain(self._preempt_signum, it):
+                print(
+                    f"[elastic] process {jax.process_index()} published a "
+                    f"drain request (signal {self._preempt_signum}, iter "
+                    f"{it})",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                self.telemetry.event(
+                    "elastic",
+                    event="drain_request",
+                    iter=it,
+                    signal=int(self._preempt_signum),
+                )
+        commit = coordinator.poll(it)
+        if commit is not None and not self._drain_commit_logged:
+            self._drain_commit_logged = True
+            self.telemetry.event(
+                "elastic",
+                event="drain_commit",
+                iter=it,
+                drain_iter=int(commit["drain_iter"]),
+                signal=int(commit.get("signal", signal.SIGTERM)),
+                requested_by=int(commit.get("requested_by", -1)),
+            )
+        commit = coordinator.should_drain(it) if commit is not None else None
+        if commit is not None:
+            if self._preempt_signum is None:
+                # this process never saw the scheduler's signal; the commit
+                # carries it (the drain must still exit PREEMPT_EXIT_CODE)
+                self._preempt_signum = int(
+                    commit.get("signal", signal.SIGTERM)
+                )
+            print(
+                f"[elastic] process {jax.process_index()} draining at "
+                f"agreed iter {it} (commit drain_iter="
+                f"{int(commit['drain_iter'])})",
+                file=sys.stderr,
+                flush=True,
+            )
+            self.telemetry.event(
+                "elastic",
+                event="drain_ack",
+                iter=it,
+                drain_iter=int(commit["drain_iter"]),
+            )
+            self._preempt_exit()
+
     def _install_signal_handlers(self) -> Optional[Dict]:
         """Install the graceful-preemption SIGTERM/SIGINT handlers for the
         duration of ``run_experiment`` (restored by the caller). Returns the
@@ -625,6 +757,7 @@ class ExperimentBuilder:
         )
         self._beat("preempt_drain")
         ckpt.wait_for_pending()  # pending async epoch save lands first
+        self._stamp_elastic_state()
         exp_state = dict(self.state)
         exp_state["emergency_reason"] = "preemption"
         exp_state["preempt_signal"] = signum
@@ -640,6 +773,11 @@ class ExperimentBuilder:
             site="ckpt_save",
         )
         ckpt.wait_for_pending()  # on disk before the exit, not after
+        if self._drain_coordinator is not None and self.is_primary:
+            # the drain is consumed: every process has observed the commit
+            # (the collective emergency save above barriered them all), so
+            # the coordination files can never strand a resumed run
+            self._drain_coordinator.clear()
         self.telemetry.event(
             "preemption", iter=it, signal=signum, checkpoint=ckpt_path,
         )
@@ -940,6 +1078,7 @@ class ExperimentBuilder:
         anomaly = mon.halt_anomaly or {}
         it = int(anomaly.get("iter", self.state["current_iter"]))
         self._beat("emergency_checkpoint")
+        self._stamp_elastic_state()
         # essential write behind the retry seam: a transient fault must not
         # lose the divergent state the postmortem needs
         ckpt_path = self.retry.call(
@@ -1407,6 +1546,9 @@ class ExperimentBuilder:
                     # next attempt, and the run would train on with the
                     # previous checkpoint permanently missing
                     wait_for_pending()
+                    # topology-portable resume keys (episode cursor +
+                    # writing process count) ride every checkpoint
+                    self._stamp_elastic_state()
                     # essential write: transient failures retried with
                     # backoff; an exhausted budget halts the run cleanly
                     # (RetriesExhaustedError) — training past a lost
@@ -1455,12 +1597,14 @@ class ExperimentBuilder:
                         self._active_pbar = self._pbar(
                             cfg.total_iter_per_epoch, f"train epoch {self.epoch}"
                         )
-                if self._preempt_signum is not None:
-                    # drained AFTER the epoch-boundary block: a signal that
-                    # lands near a boundary lets the epoch finish its
-                    # stats/checkpoint bookkeeping first, so the resumed
-                    # run's history has no hole
-                    self._preempt_exit()
+                # drained AFTER the epoch-boundary block: a signal that
+                # lands near a boundary lets the epoch finish its
+                # stats/checkpoint bookkeeping first, so the resumed
+                # run's history has no hole. Multi-process runs route
+                # through the coordinated drain (resilience/elastic.py):
+                # a local latch publishes a request, and EVERY process —
+                # signalled or not — drains at the committed iteration
+                self._check_drain()
             if pending:
                 # safety net: the loader always ends at an epoch boundary,
                 # but a truncated stream must not drop trained-sample work

@@ -33,7 +33,11 @@ the in-flight path, and at interpreter exit.
 Multi-process runs keep the synchronous collective path (``save_checkpoint``)
 with its cross-host barriers: the per-dispatch overhead the async path
 amortizes is a single-host tunnel artifact, and the primary-only swap logic
-would otherwise need a third barrier.
+would otherwise need a third barrier.  Those barriers are BOUNDED
+(``_process_barrier``, ``ckpt_follower_timeout_s``): a gang member that
+dies mid-save turns into ``CheckpointBarrierTimeoutError`` on the
+survivors — naming the phase and the primary's expected swap path —
+instead of an unbounded spin-wait.
 """
 
 from __future__ import annotations
@@ -53,6 +57,38 @@ from ..core.maml import MetaState
 from ..resilience import faults
 
 _EXPERIMENT_STATE_FILE = "experiment_state.json"
+
+
+class CheckpointBarrierTimeoutError(RuntimeError):
+    """A cross-process checkpoint barrier expired: some process never
+    arrived (killed mid-save, wedged collective, dead shared filesystem).
+
+    Replaces the former unbounded wait — a non-primary process used to
+    spin at the post-swap synchronization forever if the primary died
+    between orbax's write and the tmp -> final swap. The message names the
+    phase, the primary's expected swap path and the crash-forensics
+    siblings (``<path>.tmp`` = swap never started, ``<path>.old`` = killed
+    between the two renames; ``_recover_interrupted_swap`` restores the
+    latter on the next load), so the triage decision ships in the
+    exception. Tune the bound with ``ckpt_follower_timeout_s``.
+    """
+
+    def __init__(self, phase: str, path: str, timeout_s: float,
+                 cause: Optional[BaseException] = None):
+        self.phase = phase
+        self.path = path
+        self.timeout_s = float(timeout_s)
+        super().__init__(
+            f"checkpoint barrier {phase!r} for {path} expired after "
+            f"{timeout_s:.0f}s: not every process arrived"
+            + (f" ({cause!r})" if cause is not None else "")
+            + f". The primary's swap should have produced {path} (look for "
+            f"{path}.tmp — swap never started — or {path}.old — killed "
+            "between renames; the next load recovers it). Likely causes: a "
+            "process died mid-save, or the shared filesystem stalled; "
+            "restart the gang with continue_from_epoch='latest' (raise "
+            "ckpt_follower_timeout_s if the filesystem is just slow)."
+        )
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -144,6 +180,129 @@ def _ckpt_dir(model_save_dir: str, model_name: str, model_idx) -> str:
     return os.path.join(model_save_dir, f"{model_name}_{model_idx}")
 
 
+#: default bound on the collective save's cross-process barriers; the
+#: builder passes cfg.ckpt_follower_timeout_s instead
+DEFAULT_BARRIER_TIMEOUT_S = 600.0
+
+# per-(name, idx) barrier sequence numbers: barrier ids must be unique per
+# crossing, and every process calls save_checkpoint in the same
+# deterministic order, so a module-level counter agrees across the gang
+# (the coordination service restarts with the gang, so resumes agree too).
+# Known limit: a PER-PROCESS retry of the collective save (one worker's
+# transient OSError re-entering save_checkpoint alone) desynchronizes the
+# sequence — the gang then fails BOUNDED and diagnosable via
+# CheckpointBarrierTimeoutError on every process (the pre-elastic
+# sync_global_devices path wedged forever in the same scenario); a
+# gang-coordinated retry would need a cross-process agreement of its own.
+_barrier_seq: Dict[str, int] = {}
+
+
+_orbax_sync_rerouted = False
+# bound for the rerouted orbax barriers when orbax itself passes no
+# timeout: kept in lockstep with the configured ckpt_follower_timeout_s by
+# the save/load entry points (a mutable cell the closure reads, so raising
+# the config knob also raises orbax's internal sync bound)
+_orbax_barrier_timeout_s = [DEFAULT_BARRIER_TIMEOUT_S]
+
+
+def _reroute_orbax_sync_through_coordination_service() -> None:
+    """Replace orbax's cross-process sync (a jitted 4-byte device psum via
+    ``multihost_utils.sync_global_devices``) with the coordination-service
+    barrier, once per process, in multi-process runs.
+
+    The device-psum barrier is a COLLECTIVE PROGRAM: on backends whose
+    cross-process collectives share one tag space per process pair
+    (XLA:CPU gloo), a barrier psum from one process can interleave against
+    a different in-flight collective on a peer and corrupt the transport
+    ("op.preamble.length <= op.nbytes" aborts — observed reliably in the
+    multi-process test-ensemble phase, where checkpoint restores alternate
+    with eval dispatches). The coordination service is the same mechanism
+    orbax's async path and our ``_process_barrier`` already use, provides
+    identical happens-before guarantees, and keeps checkpoint
+    synchronization off the device interconnect entirely — also one less
+    compiled program per barrier on real pods.
+    """
+    global _orbax_sync_rerouted
+    if _orbax_sync_rerouted or jax.process_count() <= 1:
+        return
+    from jax._src import distributed as jax_distributed
+
+    client = jax_distributed.global_state.client
+    if client is None:
+        return  # no coordination service: leave orbax's default in place
+    try:
+        from orbax.checkpoint import multihost as ocp_multihost
+        from orbax.checkpoint.multihost import utils as ocp_mh_utils
+    except ImportError:
+        return
+
+    def _sync(name: str, *, timeout=None, processes=None,
+              barrier_sync_fn=None, **_kwargs) -> None:
+        if processes is not None and len(processes) <= 1:
+            return
+        bound = timeout or _orbax_barrier_timeout_s[0]
+        try:
+            # orbax barrier names are unique per use (its contract), so
+            # they map 1:1 onto coordination-service barrier ids
+            client.wait_at_barrier(
+                f"orbax_{name}", timeout_in_ms=int(bound * 1000)
+            )
+        except Exception as e:  # noqa: BLE001 - expiry surfaces as a raw
+            # backend JaxRuntimeError; give it the same operator guidance
+            # as the repo's own checkpoint barriers
+            raise RuntimeError(
+                f"orbax checkpoint sync barrier {name!r} expired after "
+                f"{bound:.0f}s: not every process arrived (a gang member "
+                "died mid-save/restore, or the shared filesystem stalled "
+                "— raise ckpt_follower_timeout_s if it is just slow)"
+            ) from e
+
+    for mod in (ocp_mh_utils, ocp_multihost):
+        mod.sync_global_processes = _sync
+    try:  # legacy aliases some orbax call sites import
+        from orbax.checkpoint import utils as ocp_utils
+
+        ocp_utils.sync_global_processes = _sync
+        ocp_utils.sync_global_devices = _sync
+    except (ImportError, AttributeError):
+        pass
+    _orbax_sync_rerouted = True
+
+
+def _process_barrier(name: str, swap_path: str, timeout_s: float,
+                     phase: str) -> None:
+    """A BOUNDED cross-process barrier for the collective checkpoint path,
+    via the jax coordination-service client (the same service the
+    collectives and orbax already depend on). Replaces the former
+    unbounded ``sync_global_devices`` spin: expiry raises
+    ``CheckpointBarrierTimeoutError`` naming the phase and the primary's
+    expected swap path instead of wedging every surviving process forever.
+    Also a chaos-injectable seam (site ``barrier``)."""
+    faults.fire("barrier")  # injectable seam (resilience/faults.py)
+    seq = _barrier_seq.get(name, 0) + 1
+    _barrier_seq[name] = seq
+    from jax._src import distributed as jax_distributed
+
+    client = jax_distributed.global_state.client
+    if client is None:
+        # multi-process jax without an initialized coordination service
+        # cannot happen through initialize_distributed; degrade to the
+        # legacy unbounded barrier rather than skipping synchronization
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"{name}_{seq}")
+        return
+    try:
+        client.wait_at_barrier(
+            f"ckpt_{name}_{seq}", timeout_in_ms=max(1, int(timeout_s * 1000))
+        )
+    except Exception as e:  # noqa: BLE001 - the runtime surfaces expiry as
+        # a backend-specific JaxRuntimeError (DEADLINE_EXCEEDED)
+        raise CheckpointBarrierTimeoutError(
+            phase, swap_path, timeout_s, cause=e
+        ) from e
+
+
 class _NumpyEncoder(json.JSONEncoder):
     def default(self, obj):
         if isinstance(obj, (np.floating, np.integer)):
@@ -159,24 +318,33 @@ def save_checkpoint(
     model_idx,
     state: MetaState,
     experiment_state: Dict[str, Any],
+    barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
 ) -> str:
     """Write one checkpoint directory (ref: save_model,
-    few_shot_learning_system.py:399-408)."""
+    few_shot_learning_system.py:399-408).
+
+    Multi-process runs synchronize through BOUNDED barriers
+    (``_process_barrier``): a gang member that dies mid-save surfaces as a
+    ``CheckpointBarrierTimeoutError`` on the survivors after
+    ``barrier_timeout_s`` instead of an unbounded spin-wait on the
+    primary's swap."""
     wait_for_pending()  # serialize with any in-flight async save
     faults.fire("ckpt_save")  # injectable seam (resilience/faults.py)
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     tmp = path + ".tmp"
     multiprocess = jax.process_count() > 1
+    if multiprocess:
+        _orbax_barrier_timeout_s[0] = float(barrier_timeout_s)
+        _reroute_orbax_sync_through_coordination_service()
     if not multiprocess or jax.process_index() == 0:
         shutil.rmtree(tmp, ignore_errors=True)
     if multiprocess:
-        from jax.experimental import multihost_utils
-
         # a killed run can leave a stale tmp on the shared filesystem; no
         # process may reach orbax's destination-exists check before the
         # primary's cleanup lands
-        multihost_utils.sync_global_devices(
-            f"ckpt_tmp_clean_{model_name}_{model_idx}"
+        _process_barrier(
+            f"tmp_clean_{model_name}_{model_idx}", path, barrier_timeout_s,
+            phase="tmp_clean",
         )
     ckptr = ocp.StandardCheckpointer()
     # collective in multi-process runs: every process calls save on the SAME
@@ -191,12 +359,12 @@ def save_checkpoint(
             json.dump(experiment_state, f, cls=_NumpyEncoder)
         _swap_into_place(tmp, path)
     if multiprocess:
-        from jax.experimental import multihost_utils
-
         # non-primary processes must not race ahead and load (or re-save)
-        # before the primary's swap lands
-        multihost_utils.sync_global_devices(
-            f"ckpt_swap_{model_name}_{model_idx}"
+        # before the primary's swap lands — the follower path: bounded, and
+        # the expiry diagnosis names the expected swap path
+        _process_barrier(
+            f"swap_{model_name}_{model_idx}", path, barrier_timeout_s,
+            phase="swap",
         )
     return path
 
@@ -334,10 +502,19 @@ def load_checkpoint(
     """
     wait_for_pending()  # never read past an in-flight async save
     faults.fire("ckpt_restore")  # injectable seam (resilience/faults.py)
+    if jax.process_count() > 1:
+        _reroute_orbax_sync_through_coordination_service()
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     _recover_interrupted_swap(path)
+    # restore template: HOST numpy arrays, not ShapeDtypeStructs. A
+    # ShapeDtypeStruct template makes orbax rebuild each leaf's recorded
+    # jax sharding — which names the devices of the gang that WROTE the
+    # checkpoint and fails to deserialize on any other topology (elastic
+    # resume on N±1 hosts would die right here). A numpy template restores
+    # plain host arrays with no device opinion at all; the caller
+    # (system.load_model) re-replicates over whatever mesh exists NOW.
     abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        lambda x: np.zeros(x.shape, x.dtype)
         if hasattr(x, "shape")
         else x,
         target_state._asdict(),

@@ -154,6 +154,26 @@ class MAMLFewShotClassifier:
         # on first use per set; per-batch H2D is then index tensors only
         self._host_stores: Dict[str, np.ndarray] = {}
         self._device_stores: Dict[str, Any] = {}
+        # elastic sharded-store tier (store_sharding='hosts'): when the
+        # mesh has a >1 host (DCN) axis, resident stores shard their row
+        # axis over it instead of replicating — per-host HBM drops to
+        # store/n_hosts; the indexed steps switch to the masked-gather +
+        # hosts-psum expansion (ops/device_pipeline.make_sharded_gather),
+        # bit-exact with the replicated gather. _store_mesh is None when
+        # inactive (replicated stores, the pre-elastic programs verbatim).
+        self._store_mesh = None
+        self._resolve_store_sharding()
+        # XLA:CPU's gloo collectives pair ops between processes by channel
+        # id, and DIFFERENT executables number their channels from the
+        # same base — so two programs in flight at once (or in different
+        # orders on different processes) corrupt the TCP pairs ("preamble
+        # length" aborts). On the CPU test rig every multihost dispatch is
+        # therefore fully synchronous: exactly one program's collectives
+        # on the wire at any instant. Real accelerator pods keep the
+        # one-step-lag pipeline (their collectives are stream-ordered).
+        self._serialize_dispatches = (
+            self.multihost and jax.default_backend() == "cpu"
+        )
         self._train_steps_indexed: Dict[Any, Any] = {}
         self._train_multi_steps_indexed: Dict[Any, Any] = {}
         self._eval_steps_indexed: Dict[Any, Any] = {}
@@ -167,6 +187,53 @@ class MAMLFewShotClassifier:
         # every dispatch at a single attribute check (same off-path
         # discipline as resilience.faults)
         self.retrace_detector = None
+
+    def _resolve_store_sharding(self) -> None:
+        """Decide whether the sharded-store tier is active: requested by
+        ``store_sharding='hosts'`` AND the mesh actually has a >1 host
+        axis to shard over. Single-host meshes (no DCN axis) degrade to
+        replication with a log line — the knob is a pod-scale memory
+        optimisation, not a correctness switch (the sharded gather is
+        bit-exact with the replicated one). Re-callable: tests that
+        install a simulated hybrid mesh re-resolve before first dispatch."""
+        self._store_mesh = None
+        if self.cfg.store_sharding != "hosts":
+            return
+        from ..parallel.distributed import DATA_AXIS
+
+        if (
+            self.mesh is not None
+            and DATA_AXIS in self.mesh.axis_names
+            and self.mesh.shape[DATA_AXIS] > 1
+        ):
+            self._store_mesh = self.mesh
+        else:
+            print(
+                "[system] store_sharding='hosts' requested but the mesh has "
+                "no multi-host axis; resident stores stay replicated",
+                flush=True,
+            )
+
+    def _sync_handle(self, metrics):
+        """What the one-step-lag sync blocks on for this dispatch.
+
+        Single-host: the loss scalar — ready status is a proxy for "the
+        device is one step behind", the cheapest backpressure signal.
+        Multi-host: the FULL metrics dict. Cross-process collectives on
+        the CPU backend (gloo) share one tag space per process pair, so no
+        program's collectives may still be in flight when the next
+        program's start; blocking on every metric output guarantees the
+        dispatch's last all-reduce has landed before anything else is
+        enqueued. On real pods the extra wait is the tail of the metric
+        psums — negligible next to the step itself."""
+        return metrics if self.multihost else metrics["loss"]
+
+    def _maybe_serialize(self, *trees) -> None:
+        """CPU-multihost only (see ``_serialize_dispatches``): force every
+        output of the dispatch just enqueued, so no two programs ever
+        overlap on the gloo transport."""
+        if self._serialize_dispatches:
+            jax.block_until_ready(trees)
 
     def _observe_dispatch(self, site: str, args: tuple) -> None:
         """Hash the abstract signature of a dispatch for the retrace
@@ -209,7 +276,10 @@ class MAMLFewShotClassifier:
         key = (second_order, augment)
         if key not in self._train_steps_indexed:
             self._train_steps_indexed[key] = jax.jit(
-                maml.make_train_step_indexed(self.cfg, second_order, augment),
+                maml.make_train_step_indexed(
+                    self.cfg, second_order, augment,
+                    store_mesh=self._store_mesh,
+                ),
                 # state only — never the resident store (argnum 1)
                 donate_argnums=maml.TRAIN_DONATE,
             )
@@ -220,7 +290,8 @@ class MAMLFewShotClassifier:
         if key not in self._train_multi_steps_indexed:
             self._train_multi_steps_indexed[key] = jax.jit(
                 maml.make_train_multi_step_indexed(
-                    self.cfg, second_order, augment
+                    self.cfg, second_order, augment,
+                    store_mesh=self._store_mesh,
                 ),
                 donate_argnums=maml.TRAIN_DONATE,
             )
@@ -229,7 +300,9 @@ class MAMLFewShotClassifier:
     def _eval_step_indexed(self, augment: bool):
         if augment not in self._eval_steps_indexed:
             self._eval_steps_indexed[augment] = jax.jit(
-                maml.make_eval_step_indexed(self.cfg, augment)
+                maml.make_eval_step_indexed(
+                    self.cfg, augment, store_mesh=self._store_mesh
+                )
             )
         return self._eval_steps_indexed[augment]
 
@@ -237,7 +310,10 @@ class MAMLFewShotClassifier:
         key = (with_preds, augment)
         if key not in self._eval_multi_steps_indexed:
             self._eval_multi_steps_indexed[key] = jax.jit(
-                maml.make_eval_multi_step_indexed(self.cfg, with_preds, augment)
+                maml.make_eval_multi_step_indexed(
+                    self.cfg, with_preds, augment,
+                    store_mesh=self._store_mesh,
+                )
             )
         return self._eval_multi_steps_indexed[key]
 
@@ -260,7 +336,9 @@ class MAMLFewShotClassifier:
                     "does this automatically)"
                 )
             store = self._host_stores[set_name]
-            if self.multihost:
+            if self._store_mesh is not None:
+                arr = self._place_sharded_store(store)
+            elif self.multihost:
                 # every host holds the full (deterministically built) store;
                 # replicate it over the global mesh — index batches are what
                 # shard over the task axis (see parallel.mesh.replicate_array)
@@ -274,6 +352,31 @@ class MAMLFewShotClassifier:
                 arr = jax.device_put(store)
             self._device_stores[set_name] = arr
         return self._device_stores[set_name]
+
+    def _place_sharded_store(self, store: np.ndarray):
+        """Place one flat store with its row axis sharded over the mesh's
+        host (DCN) axis (``store_sharding='hosts'``): each host uploads
+        only its 1/n_hosts row block — rows zero-padded to shard evenly;
+        padding is unreachable (gather indices stay < the logical row
+        count) and masked in the sharded gather anyway."""
+        from ..ops.device_pipeline import pad_store_rows
+        from ..parallel import distributed
+
+        mesh = self._store_mesh
+        n_shards = mesh.shape[distributed.DATA_AXIS]
+        padded = pad_store_rows(np.asarray(store), n_shards)
+        sharding = distributed.store_row_sharding(mesh)
+        if self.multihost:
+            rows_per = padded.shape[0] // n_shards
+            h = jax.process_index()
+            local = np.ascontiguousarray(
+                padded[h * rows_per:(h + 1) * rows_per]
+            )
+            return jax.make_array_from_process_local_data(
+                sharding, local, padded.shape
+            )
+        # simulated-hosts mesh (tests): one process holds every shard
+        return jax.device_put(padded, sharding)
 
     def _prepare_index_batch(self, batch: IndexBatch):
         """Place one IndexBatch's (gather, rot_k) tensors — the task axis
@@ -435,7 +538,8 @@ class MAMLFewShotClassifier:
             self.state, metrics = self._train_step_indexed(
                 second_order, augment
             )(self.state, store, gather, rot_k, weights, lr)
-            self._pending_sync = metrics["loss"]
+            self._pending_sync = self._sync_handle(metrics)
+            self._maybe_serialize(self.state, metrics)
             losses = dict(metrics)
             for i, w in enumerate(anneal):
                 losses[f"loss_importance_vector_{i}"] = float(w)
@@ -456,7 +560,8 @@ class MAMLFewShotClassifier:
         self.state, metrics = self._train_step(second_order)(
             self.state, x_s, y_s, x_t, y_t, weights, lr
         )
-        self._pending_sync = metrics["loss"]
+        self._pending_sync = self._sync_handle(metrics)
+        self._maybe_serialize(self.state, metrics)
         # metrics stay device arrays — the float() happens when the builder
         # summarizes an epoch; through a networked device transport every
         # forced per-step sync would be a round-trip
@@ -514,7 +619,7 @@ class MAMLFewShotClassifier:
             self.state, metrics = self._train_multi_step_indexed(
                 second_order, augment, k
             )(self.state, store, *placed, weights, lr)
-            self._pending_sync = metrics["loss"]
+            self._pending_sync = self._sync_handle(metrics)
             losses = dict(metrics)  # values are (k,) device arrays
             for j, w in enumerate(anneal):
                 losses[f"loss_importance_vector_{j}"] = float(w)
@@ -534,7 +639,7 @@ class MAMLFewShotClassifier:
         self.state, metrics = self._train_multi_step(second_order, k)(
             self.state, *stacked, weights, lr
         )
-        self._pending_sync = metrics["loss"]
+        self._pending_sync = self._sync_handle(metrics)
         losses: Dict[str, Any] = dict(metrics)  # values are (k,) device arrays
         for j, w in enumerate(anneal):
             losses[f"loss_importance_vector_{j}"] = float(w)
@@ -573,13 +678,21 @@ class MAMLFewShotClassifier:
                     "eval_step", (self.state, x_s, y_s, x_t, y_t)
                 )
             metrics, preds = self._eval_step(self.state, x_s, y_s, x_t, y_t)
-        self._pending_sync = metrics["loss"]
+        self._pending_sync = self._sync_handle(metrics)
+        self._maybe_serialize(metrics, preds)
         metrics = dict(metrics)  # device arrays; caller converts on summary
         out_preds = None
         if return_preds:
             if self.multihost:
                 # preds are sharded over the global task axis; the ensemble
-                # needs them all on every host
+                # needs them all on every host. Drain the eval dispatch
+                # FIRST: the allgather is its own program, and running its
+                # collective while the eval step's metric all-reduces are
+                # still in flight corrupts backends whose collectives share
+                # one tag space per process pair (XLA:CPU gloo aborts with
+                # a preamble-length mismatch); on real pods this wait is
+                # subsumed by the d2h fetch below anyway
+                jax.block_until_ready(metrics["loss"])
                 from jax.experimental import multihost_utils
 
                 preds = multihost_utils.process_allgather(preds, tiled=True)
@@ -643,7 +756,7 @@ class MAMLFewShotClassifier:
             metrics, preds = self._eval_multi_step(return_preds)(
                 self.state, *stacked
             )
-        self._pending_sync = metrics["loss"]
+        self._pending_sync = self._sync_handle(metrics)
         out_preds = np.asarray(preds) if return_preds else None
         return dict(metrics), out_preds
 
@@ -691,12 +804,23 @@ class MAMLFewShotClassifier:
         already made resident via ``_device_store``). A growing gap between
         ``bytes_in_use`` and the expected resident set is the leak signal
         the telemetry sink records each epoch."""
+        # sharded stores resident per HOST at 1/n_hosts of the full bytes
+        # (plus negligible row padding) — the expectation must match what
+        # this host actually holds or the leak signal would always fire
+        shards = 1
+        if self._store_mesh is not None:
+            from ..parallel.distributed import DATA_AXIS
+
+            shards = int(self._store_mesh.shape[DATA_AXIS])
         out: Dict[str, Any] = {
             "store_bytes_expected": sum(
-                int(self._host_stores[name].nbytes)
+                int(self._host_stores[name].nbytes) // shards
                 for name in self._device_stores
             ),
             "stores_resident": sorted(self._device_stores),
+            "store_sharding": (
+                "replicated" if self._store_mesh is None else "hosts"
+            ),
         }
         try:
             stats = jax.local_devices()[0].memory_stats()
@@ -717,6 +841,11 @@ class MAMLFewShotClassifier:
         """
         if not self.multihost:
             return np.asarray(a)
+        # same discipline as the preds allgather in run_validation_iter: no
+        # other program's collectives may be in flight when this one runs
+        # (XLA:CPU gloo shares a tag space per process pair)
+        if self._pending_sync is not None:
+            jax.block_until_ready(self._pending_sync)
         from jax.experimental import multihost_utils
 
         return np.asarray(
@@ -737,14 +866,21 @@ class MAMLFewShotClassifier:
         via a second collective save (the async path is single-host only).
         """
         if self.multihost:
+            # drain the in-flight dispatch first: orbax's collective save
+            # synchronizes with a small device psum of its own, and no
+            # other program's collectives may be in flight when it runs
+            # (XLA:CPU gloo shares a tag space per process pair)
+            if self._pending_sync is not None:
+                jax.block_until_ready(self._pending_sync)
+            timeout = float(self.cfg.ckpt_follower_timeout_s)
             path = ckpt.save_checkpoint(
                 model_save_dir, "train_model", model_idx, self.state,
-                experiment_state,
+                experiment_state, barrier_timeout_s=timeout,
             )
             if also_latest:
                 ckpt.save_checkpoint(
                     model_save_dir, "train_model", "latest", self.state,
-                    experiment_state,
+                    experiment_state, barrier_timeout_s=timeout,
                 )
             return path
         return ckpt.save_checkpoint_async(
@@ -754,6 +890,11 @@ class MAMLFewShotClassifier:
         )
 
     def load_model(self, model_save_dir: str, model_idx) -> Dict[str, Any]:
+        if self.multihost and self._pending_sync is not None:
+            # same discipline as the multihost save: the collective restore
+            # must not overlap an in-flight dispatch's collectives (the
+            # test ensemble hops checkpoints with an eval still pending)
+            jax.block_until_ready(self._pending_sync)
         self.state, experiment_state = ckpt.load_checkpoint(
             model_save_dir, "train_model", model_idx, self.state
         )

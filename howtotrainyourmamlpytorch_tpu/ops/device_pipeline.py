@@ -24,7 +24,7 @@ episode RNG mid-stream and cannot be replayed from indices.
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,8 +100,84 @@ def _rot_stack(imgs: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def pad_store_rows(store: np.ndarray, num_shards: int) -> np.ndarray:
+    """Zero-pad a flat store's row axis to a multiple of ``num_shards`` so
+    it shards evenly; padding rows are unreachable (every gather index is
+    < the logical row count) and masked anyway in the sharded gather."""
+    rows = store.shape[0]
+    rem = rows % num_shards
+    if rem == 0:
+        return store
+    pad = num_shards - rem
+    return np.concatenate(
+        [store, np.zeros((pad,) + store.shape[1:], store.dtype)], axis=0
+    )
+
+
+def make_sharded_gather(
+    cfg: MAMLConfig, store_mesh, store_axis: str
+) -> Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+    """(row-sharded store, gather) -> decoded float pixels, for resident
+    stores sharded over a mesh axis (``store_sharding='hosts'``).
+
+    Each shard gathers the requested rows it OWNS (out-of-shard indices
+    clipped and masked to zero after decode) and a ``psum`` over the store
+    axis assembles the full decoded batch. Exactly one shard contributes a
+    non-zero value per row, so the sum is bit-exact with the replicated
+    ``decode(store[gather])`` — float addition with zero is exact. The
+    collective moves the decoded *batch* (float32), never the store and
+    never uint8 pixels, so the PR 8 SPMD invariants (zero store-sized
+    collectives, zero uint8 collectives) hold by construction; the output
+    is then constrained back to the batch sharding so every downstream op
+    — and therefore the gradient all-reduce order — is identical to the
+    replicated-store program.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import TASK_AXIS
+
+    decode = make_decoder(cfg)
+    task_axes = tuple(a for a in store_mesh.axis_names if a != store_axis)
+    # the canonical batch sharding on this mesh: tasks over every axis,
+    # store axis major (parallel.distributed.global_batch_sharding)
+    batch_spec = P(tuple([store_axis, *task_axes]))
+
+    def local_gather(store_shard, gather):
+        # store_shard: this shard's (rows/n, h, w, c) uint8 block
+        shard_rows = store_shard.shape[0]
+        lo = jax.lax.axis_index(store_axis) * shard_rows
+        local = gather - lo
+        ok = (local >= 0) & (local < shard_rows)
+        imgs = store_shard[jnp.clip(local, 0, shard_rows - 1)]
+        x = decode(imgs)
+        # mask AFTER decode: decode(0) != 0 under stat-normalization
+        x = jnp.where(ok[..., None, None, None], x, jnp.zeros((), x.dtype))
+        return jax.lax.psum(x, store_axis)
+
+    task_spec = P(TASK_AXIS if TASK_AXIS in task_axes else None)
+    sharded = shard_map(
+        local_gather,
+        mesh=store_mesh,
+        in_specs=(P(store_axis), task_spec),
+        out_specs=task_spec,
+    )
+
+    def gather_decode(store, gather):
+        x = sharded(store, gather)
+        # replicated-over-store-axis -> batch sharding: a local slice (zero
+        # communication), restoring the exact compute layout of the
+        # replicated-store program
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(store_mesh, batch_spec)
+        )
+
+    return gather_decode
+
+
 def make_index_expander(
-    cfg: MAMLConfig, augment: bool
+    cfg: MAMLConfig, augment: bool, store_mesh=None,
+    store_axis: Optional[str] = None,
 ) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """(store, gather, rot_k) -> (x_s, y_s, x_t, y_t), all on device.
 
@@ -114,8 +190,20 @@ def make_index_expander(
     ``augment`` is static (per-set: train-time Omniglot only, matching the
     ``augment_stack`` gate) so the no-rotation programs pay nothing for the
     switch machinery.
+
+    ``store_mesh``/``store_axis`` select the sharded-store gather
+    (``make_sharded_gather``) for stores whose row axis is sharded over
+    ``store_axis`` of that mesh instead of replicated; None keeps the
+    plain resident gather.
     """
     decode = make_decoder(cfg)
+    gather_decode = None
+    if store_mesh is not None:
+        from ..parallel.distributed import DATA_AXIS
+
+        gather_decode = make_sharded_gather(
+            cfg, store_mesh, store_axis or DATA_AXIS
+        )
     rotate = augment and "omniglot" in cfg.dataset_name
     if rotate and cfg.image_height != cfg.image_width:
         raise ValueError(
@@ -126,8 +214,11 @@ def make_index_expander(
     spc = cfg.num_samples_per_class
 
     def expand(store, gather, rot_k):
-        imgs = store[gather]  # (tasks, n, spc+nts, h, w, c) uint8 gather
-        x = decode(imgs)
+        if gather_decode is not None:
+            x = gather_decode(store, gather)
+        else:
+            imgs = store[gather]  # (tasks, n, spc+nts, h, w, c) uint8
+            x = decode(imgs)
         if rotate:
             # per-(task, class) rotation of the (spc+nts, h, w, c) stack —
             # the vectorized form of augment_stack's np.rot90(axes=(1, 2))

@@ -114,3 +114,15 @@ def global_batch_sharding(mesh: Mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     return NamedSharding(mesh, P((DATA_AXIS, TASK_AXIS)))
+
+
+def store_row_sharding(mesh: Mesh):
+    """Shard a resident store's row axis over the host (DCN) axis,
+    replicated across each host's own (task-axis) devices — the elastic
+    ``store_sharding='hosts'`` layout: per-host HBM holds store/n_hosts,
+    and the on-device gather runs as the masked local gather + hosts-psum
+    of ``ops.device_pipeline.make_sharded_gather`` (batch-sized float32
+    collective; the store itself never crosses the interconnect)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS))

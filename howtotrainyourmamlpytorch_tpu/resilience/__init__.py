@@ -16,6 +16,12 @@ Everything here is host-side: with ``fault_spec`` unset the injector is
 programs are untouched by construction (tested).
 """
 
+from .elastic import (  # noqa: F401
+    DrainCoordinator,
+    episode_cursor_for_iter,
+    process_for_index,
+    shard_slice,
+)
 from .faults import (  # noqa: F401
     FAULT_ACTIONS,
     FAULT_SITES,

@@ -1,0 +1,306 @@
+"""Elastic multi-host training: coordinated preemption drain + the
+topology-invariant episode schedule.
+
+Two pieces turn the single-process preemption story (PR 6) into a
+pod-grade one:
+
+**Coordinated drain.** On a pod, the scheduler SIGTERMs *one* worker (or
+each worker at a slightly different instant). Draining only the signalled
+process would wedge every other process in the next collective; draining
+each process at *its own* next dispatch boundary would have them reach the
+collective emergency checkpoint at different iterations — a deadlock. The
+:class:`DrainCoordinator` is the lightweight cross-process agreement seam:
+
+* any signalled worker publishes a **drain request** (an atomic JSON file
+  in a shared coordination directory — the experiment directory is already
+  the shared-filesystem rendezvous the collective checkpoints rely on);
+* the **primary** polls for requests at its dispatch boundaries and
+  publishes a **drain commit** naming the agreed iteration
+  ``drain_iter = primary_iter + margin`` — the margin
+  (``drain_margin_iters``) covers host-loop skew (bounded to ~1 dispatch
+  by the one-step-lag sync) plus one polling interval, so every process
+  observes the commit *before* reaching ``drain_iter``;
+* every process (primary included) polls for the commit at its dispatch
+  boundaries and keeps training until ``current_iter >= drain_iter``, then
+  runs the ordinary preemption drain — the emergency checkpoint is the
+  *collective* ``save_checkpoint`` at the same iteration on every process,
+  written once, and every process exits ``PREEMPT_EXIT_CODE``.
+
+If a process somehow overshoots the committed iteration (a pathologically
+slow shared filesystem), it drains at its own next boundary and says so
+loudly; the collective save then fails *diagnosably* via the bounded
+follower wait in ``experiment/checkpoint.py`` instead of hanging forever.
+Every poll crosses the ``drain_poll`` fault-injection seam and every
+publish the same atomic tmp+rename discipline as the checkpoints.
+
+**Topology-invariant episode schedule.** Episode seeds are a pure function
+of ``(base seed, global episode index)`` (data/episodes.py), so the only
+topology-dependent thing about the stream is which *process* builds which
+index. The schedule below fixes that as a pure function too:
+
+* the global episode cursor advances ``tasks_per_batch`` per iteration
+  (``episode_cursor_for_iter``) and is checkpointed in the experiment
+  state, so a resumed run re-derives nothing from the current topology;
+* within each global batch, process ``p`` of ``P`` owns the contiguous
+  index block ``[p * tpb/P, (p+1) * tpb/P)`` (``shard_slice`` /
+  ``process_for_index``). The *block* partition — rather than
+  ``global_index % P`` striding — is deliberate: the global device batch
+  is assembled process-major (``make_array_from_process_local_data``), so
+  a block partition reproduces the exact global task order of a
+  single-process run for ANY process count. The resumed global episode
+  sequence is therefore bit-identical to the uninterrupted one,
+  re-partitioned — a striding partition would permute tasks inside the
+  batch and change the gradient all-reduce order, breaking bit-equivalence
+  across topologies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+from . import faults
+
+#: file names inside the coordination directory (atomic JSON, tmp+rename)
+DRAIN_REQUEST_FILE = "drain_request.json"
+DRAIN_COMMIT_FILE = "drain_commit.json"
+
+
+# -- topology-invariant episode schedule (pure functions) --------------------
+
+
+def episode_cursor_for_iter(current_iter: int, tasks_per_batch: int) -> int:
+    """The global episode cursor after ``current_iter`` completed
+    iterations: the index of the next unconsumed episode. Pure function of
+    the iteration count and the *global* batch size — no topology input."""
+    return int(current_iter) * int(tasks_per_batch)
+
+
+def shard_slice(
+    tasks_per_batch: int, shard_id: int, num_shards: int
+) -> Tuple[int, int]:
+    """Process ``shard_id``'s contiguous block ``[lo, hi)`` of each global
+    batch's task indices. Requires ``num_shards`` to divide
+    ``tasks_per_batch`` (the global batch re-partitions exactly)."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for {num_shards} shards"
+        )
+    if tasks_per_batch % num_shards != 0:
+        raise ValueError(
+            f"global batch of {tasks_per_batch} tasks not divisible by "
+            f"{num_shards} processes, so it cannot re-partition; elastic "
+            "resume requires every anticipated process count to divide the "
+            "global batch"
+        )
+    per = tasks_per_batch // num_shards
+    return shard_id * per, (shard_id + 1) * per
+
+
+def process_for_index(
+    global_index: int, tasks_per_batch: int, num_shards: int
+) -> int:
+    """Which process builds global episode ``global_index`` under the block
+    partition — the inverse of ``shard_slice``, usable at restore time for
+    any process count."""
+    per = tasks_per_batch // num_shards
+    if tasks_per_batch % num_shards != 0:
+        raise ValueError(
+            f"{tasks_per_batch} tasks not divisible by {num_shards} shards"
+        )
+    return (int(global_index) % int(tasks_per_batch)) // per
+
+
+# -- coordinated preemption drain --------------------------------------------
+
+
+def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    """None when absent or (transiently) unreadable — the atomic writes
+    make a *parsed* file always complete."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class DrainCoordinator:
+    """The file-based drain agreement seam (see module docstring).
+
+    One instance per process per run; all instances point at the same
+    shared ``coord_dir``. Every entry point is idempotent and cheap: a
+    boundary poll with nothing published is one ``os.path.exists``.
+    """
+
+    def __init__(
+        self,
+        coord_dir: str,
+        process_index: int,
+        process_count: int,
+        margin_iters: int = 4,
+        run_tag: str = "",
+    ):
+        self.coord_dir = str(coord_dir)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.margin_iters = max(1, int(margin_iters))
+        self.is_primary = self.process_index == 0
+        # run scoping: the builder tags the coordinator with the resume
+        # iteration, so a request/commit published by a PREVIOUS incarnation
+        # of this experiment (which the drain consumed, or a crash stranded)
+        # does not preempt the resumed run — every process derives the
+        # same tag from the same checkpoint. The primary additionally
+        # clears its own tag's leftovers at construction (a re-resume from
+        # the exact same iteration after a crash mid-drain); a follower
+        # that cached such a leftover before the sweep re-validates
+        # against the filesystem at drain time (``should_drain``).
+        self.run_tag = str(run_tag)
+        self._requested = False
+        self._commit: Optional[Dict[str, Any]] = None
+        if self.is_primary:
+            self.clear()
+
+    # paths -----------------------------------------------------------------
+
+    def _tagged(self, filename: str) -> str:
+        if not self.run_tag:
+            return os.path.join(self.coord_dir, filename)
+        stem, ext = os.path.splitext(filename)
+        return os.path.join(self.coord_dir, f"{stem}_{self.run_tag}{ext}")
+
+    @property
+    def request_path(self) -> str:
+        return self._tagged(DRAIN_REQUEST_FILE)
+
+    @property
+    def commit_path(self) -> str:
+        return self._tagged(DRAIN_COMMIT_FILE)
+
+    def clear(self) -> None:
+        """Drop this run-tag's coordination files (primary: at
+        construction, and once a drain has been fully consumed — every
+        process has observed the commit by the time the collective
+        emergency checkpoint completes, so post-drain removal can strand
+        nobody). Also forgets any cached state."""
+        for path in (self.request_path, self.commit_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._commit = None
+        self._requested = False
+
+    # protocol --------------------------------------------------------------
+
+    def request_drain(self, signum: int, current_iter: int) -> bool:
+        """Publish this process's drain request. Called at the dispatch
+        boundary after a SIGTERM/SIGINT latched, from ANY process; the
+        primary's next poll turns it into a commit. Returns True on the
+        first (publishing) call, False on idempotent repeats — but
+        re-publishes if the file vanished (a request racing the primary's
+        construction-time stale-file sweep must not be silently dropped;
+        the signalled process re-asserts it every boundary until the
+        commit lands)."""
+        if self._requested and os.path.exists(self.request_path):
+            return False
+        os.makedirs(self.coord_dir, exist_ok=True)
+        _atomic_write_json(
+            self.request_path,
+            {
+                "process_index": self.process_index,
+                "signal": int(signum),
+                "iter": int(current_iter),
+            },
+        )
+        self._requested = True
+        return True
+
+    def poll(self, current_iter: int) -> Optional[Dict[str, Any]]:
+        """The dispatch-boundary poll: returns the drain commit once one
+        exists (cached thereafter — the filesystem is read at most once per
+        boundary until the commit lands). On the primary, an observed
+        request (or the primary's own) is promoted to a commit at
+        ``current_iter + margin_iters``."""
+        faults.fire("drain_poll")  # chaos-injectable seam (resilience/faults)
+        if self._commit is not None:
+            return self._commit
+        commit = (
+            _read_json(self.commit_path)
+            if os.path.exists(self.commit_path)
+            else None
+        )
+        if commit is None and self.is_primary:
+            request = (
+                _read_json(self.request_path)
+                if os.path.exists(self.request_path)
+                else None
+            )
+            if request is not None:
+                commit = {
+                    "drain_iter": int(current_iter) + self.margin_iters,
+                    "signal": int(request.get("signal", 15)),
+                    "requested_by": int(request.get("process_index", -1)),
+                    "requested_at_iter": int(request.get("iter", -1)),
+                    "committed_at_iter": int(current_iter),
+                }
+                os.makedirs(self.coord_dir, exist_ok=True)
+                _atomic_write_json(self.commit_path, commit)
+        if commit is not None:
+            self._commit = commit
+        return self._commit
+
+    def should_drain(self, current_iter: int) -> Optional[Dict[str, Any]]:
+        """The boundary check the builder's train loop calls: the commit,
+        once ``current_iter`` has reached the agreed drain iteration (None
+        otherwise — keep training). An overshoot (first sight of the commit
+        already past ``drain_iter``) drains immediately with a loud
+        warning: the collective checkpoint then either succeeds (every
+        process overshot identically) or fails diagnosably at the bounded
+        follower wait."""
+        commit = self._commit if self._commit is not None else self.poll(
+            current_iter
+        )
+        if commit is None:
+            return None
+        drain_iter = int(commit["drain_iter"])
+        if current_iter < drain_iter:
+            return None
+        # re-validate against the filesystem before acting: a follower
+        # whose very first poll raced the primary's construction-time
+        # stale-file sweep may have CACHED a previous same-tag
+        # incarnation's commit — if the file is GONE now (the sweep won),
+        # forget it instead of draining a run nobody preempted. Only true
+        # absence withdraws the commit; a transient read error keeps it
+        # (the fail-safe direction for an already-agreed drain — dropping
+        # it on an EIO blip would strand this process out of the
+        # collective emergency checkpoint).
+        try:
+            os.stat(self.commit_path)
+        except FileNotFoundError:
+            self._commit = None
+            return None
+        except OSError:
+            pass
+        if current_iter > drain_iter:
+            print(
+                f"[elastic] process {self.process_index} overshot the "
+                f"committed drain iteration {drain_iter} (now at "
+                f"{current_iter}): draining here; raise drain_margin_iters "
+                "if the shared filesystem propagates this slowly",
+                file=sys.stderr,
+                flush=True,
+            )
+        return commit

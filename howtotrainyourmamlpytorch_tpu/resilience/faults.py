@@ -8,7 +8,7 @@ Grammar (comma-separated entries)::
 
     entry   := site ":" action "@" key "=" value ["x" repeat]
     site    := ckpt_save | ckpt_finalize | ckpt_restore | stats_write
-             | json_write | producer | signal
+             | json_write | producer | signal | barrier | drain_poll
     action  := oserror | raise | sigterm | sigint | sigkill
     key     := iter | call | batch          (batch is an alias of call)
     repeat  := how many consecutive triggers fire (default 1)
@@ -27,7 +27,15 @@ Sites are the named host-side seams the experiment layer crosses:
 * ``signal``        — evaluated at the builder's dispatch boundary
   (``tick``), not at a seam call: delivers the named signal to the own
   process, modelling a TPU-pod preemption notice (sigterm), an operator
-  interrupt (sigint) or a hard kill (sigkill).
+  interrupt (sigint) or a hard kill (sigkill);
+* ``barrier``       — the cross-process synchronization points of the
+  collective checkpoint path (``experiment/checkpoint.py``: the pre-save
+  tmp-clean barrier and the post-swap follower wait), once per barrier
+  crossing per process — a sigkill here dies *inside* a checkpoint
+  barrier, the scenario the bounded follower wait exists for;
+* ``drain_poll``    — the elastic drain coordinator's dispatch-boundary
+  poll (``resilience/elastic.py``), once per boundary in multi-process
+  runs — faults here exercise a broken coordination filesystem.
 
 Conditions: ``call=N`` (``batch=N``) matches the N-th invocation of that
 seam, counted per site across the whole process — deterministic because
@@ -65,6 +73,8 @@ FAULT_SITES = (
     "json_write",
     "producer",
     "signal",
+    "barrier",
+    "drain_poll",
 )
 
 FAULT_ACTIONS = ("oserror", "raise", "sigterm", "sigint", "sigkill")

@@ -52,6 +52,15 @@ Record kinds:
   of TPU compile the shape discipline should have prevented; under
   ``analysis_level='strict'`` the record is followed by a fatal
   RetraceError;
+* ``elastic``        — elastic multi-host coordination
+  (resilience/elastic.py, schema v6): ``event`` names the step —
+  ``drain_request`` (a signalled worker published its drain request),
+  ``drain_commit`` (the primary committed the agreed drain iteration),
+  ``drain_ack`` (this process reached the agreed iteration and is
+  draining), ``resume`` (a checkpoint written by ``old_process_count``
+  processes resumed on ``new_process_count``, with the global
+  ``episode_cursor`` re-entry point) — so a pod-scale preemption or a
+  topology-changing resume documents itself in the run's own log;
 * ``analysis``       — the build-time program audit ran
   (``analysis_level != 'off'``): how many programs were audited (incl.
   the SPMD family on multi-device builds), how many contract violations
@@ -95,6 +104,12 @@ Version history / migration notes:
   validates unchanged (``tests/fixtures/telemetry_v4_schema.jsonl`` pins
   a v4-era log) and the forward-compat rules carry over (the
   future-schema fixture is re-pinned at v6-unknown).
+* **v6** — adds the ``elastic`` record kind (elastic multi-host
+  training: coordinated preemption drain events and topology-change
+  resume markers). Pure addition: every v1..v5 record validates
+  unchanged (``tests/fixtures/telemetry_v5_schema.jsonl`` pins a v5-era
+  log) and the forward-compat rules carry over (the future-schema
+  fixture is re-pinned at v7-unknown).
 """
 
 from __future__ import annotations
@@ -102,7 +117,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -126,6 +141,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "preemption": ("iter", "signal", "checkpoint"),
     "retrace": ("iter", "site", "signature"),
     "analysis": ("programs", "violations"),
+    "elastic": ("event",),
 }
 
 

@@ -20,8 +20,10 @@ here before importing anything jax-heavy)
 
 * ``summary``   — run overview: record counts by kind, wall-clock span,
   epoch range, final/best validation accuracy, dispatch-timing
-  percentiles, loader stream-stall stats, HBM usage, and
-  anomaly/incident/stall/retry/preemption/retrace counts;
+  percentiles, loader stream-stall stats, HBM usage,
+  anomaly/incident/stall/retry/preemption/retrace counts, and the
+  elastic drain/resume line (schema v6: drain protocol progress plus the
+  last old->new process-count resume with its episode cursor);
 * ``epochs``    — the per-epoch scalar table (loss/accuracy/step-time
   columns), the epoch CSV's queryable twin;
 * ``anomalies`` — every ``anomaly`` / ``incident`` / ``watchdog_stall`` /
@@ -105,6 +107,37 @@ def _mean_of(records: List[dict], kind: str, keys: Tuple[str, ...]) -> Dict[str,
         ]
         if vals:
             out[key] = sum(vals) / len(vals)
+    return out
+
+
+def _elastic_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Condense the ``elastic`` records (schema v6): drain-protocol event
+    counts plus the LAST topology-change resume marker (old/new process
+    count and the episode-cursor re-entry point). None when the run has no
+    elastic records at all."""
+    ev = [r for r in records if r.get("kind") == "elastic"]
+    if not ev:
+        return None
+    out: Dict[str, Any] = {
+        "drain_requests": sum(
+            1 for r in ev if r.get("event") == "drain_request"
+        ),
+        "drain_commits": sum(
+            1 for r in ev if r.get("event") == "drain_commit"
+        ),
+        "drain_acks": sum(1 for r in ev if r.get("event") == "drain_ack"),
+        "resumes": sum(1 for r in ev if r.get("event") == "resume"),
+        "last_resume": None,
+    }
+    last = next(
+        (r for r in reversed(ev) if r.get("event") == "resume"), None
+    )
+    if last is not None:
+        out["last_resume"] = {
+            k: last.get(k)
+            for k in ("old_process_count", "new_process_count", "iter",
+                      "episode_cursor")
+        }
     return out
 
 
@@ -195,6 +228,9 @@ def cmd_summary(args) -> int:
             ),
             None,
         ),
+        # elastic multi-host coordination (schema v6): drain protocol
+        # progress + the last topology-change resume marker
+        "elastic": _elastic_summary(records),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -261,6 +297,22 @@ def cmd_summary(args) -> int:
             f"  analysis: {payload['retraces']} mid-run retrace(s) — "
             "dispatch sites recompiled (see the anomalies timeline)"
         )
+    el = payload["elastic"]
+    if el:
+        line = (
+            f"  elastic: {el['drain_requests']} drain request(s), "
+            f"{el['drain_commits']} commit(s), {el['drain_acks']} ack(s), "
+            f"{el['resumes']} elastic resume(s)"
+        )
+        lr = el.get("last_resume")
+        if lr and lr.get("old_process_count") is not None:
+            line += (
+                f"; last resume {lr['old_process_count']} -> "
+                f"{lr['new_process_count']} process(es) @ iter "
+                f"{lr.get('iter')} (episode cursor "
+                f"{lr.get('episode_cursor')})"
+            )
+        lines.append(line)
     audit = payload["audit"]
     if audit:
         line = (

@@ -1,0 +1,95 @@
+"""Subprocess worker for the elastic kill-and-rejoin chaos harness.
+
+Launched by ``tests/test_elastic_e2e.py`` as a gang of N coordinated CPU
+processes (``jax.distributed``, ``--xla_force_host_platform_device_count``
+virtual devices each) running the production entry (``cli.main``) over a
+shared synthetic dataset — with a per-worker ``fault_spec`` so ONE member
+of the gang can be SIGKILLed or SIGTERMed deterministically mid-epoch.
+The test then resumes the experiment at a DIFFERENT process count (same
+total device count) and asserts bit-identical final params and per-epoch
+CSV against an uninterrupted baseline — the multi-host extension of
+``tests/_resilience_worker.py``'s single-process proof.
+
+The config recipe is imported from the test module
+(``test_elastic_e2e.worker_config_kwargs``) so the worker can never drift
+from the runs it is compared against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--num_processes", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--n_local_devices", type=int, required=True)
+    ap.add_argument("--data_root", required=True)
+    ap.add_argument("--exp_name", required=True)
+    ap.add_argument("--cache_dir", required=True)
+    ap.add_argument("--total_epochs", type=int, default=3)
+    ap.add_argument("--fault_spec", default="")
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.n_local_devices} "
+        + os.environ.get("MAML_ELASTIC_XLA_EXTRA", "")
+    ).strip()
+    if args.num_processes > 1:
+        # cli.main -> initialize_distributed() reads exactly these env vars
+        os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{args.port}"
+        os.environ["JAX_NUM_PROCESSES"] = str(args.num_processes)
+        os.environ["JAX_PROCESS_ID"] = str(args.process_id)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.num_processes > 1:
+        # cross-process collectives on the CPU backend need an explicit
+        # implementation (the default 'none' client rejects multiprocess
+        # computations); gloo-over-TCP ships in jaxlib and rides the same
+        # coordination service jax.distributed.initialize sets up
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # gloo cannot tolerate CONCURRENT collectives on one TCP pair: the
+        # one-step-lag pipeline keeps a dispatch in flight while the next
+        # is enqueued, and two overlapping all-reduces race the pair's
+        # preamble ("op.preamble.length <= op.nbytes" aborts, ~1 in 3
+        # runs). Inline dispatch serializes device programs, which is the
+        # correct-first choice for a CPU test rig anyway.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    # the test owns the config recipe — import it so every compared run
+    # (baseline, chaos, every resume topology) trains the identical program
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(tests_dir))  # repo root: the package
+    sys.path.insert(0, tests_dir)
+    from test_elastic_e2e import worker_config_kwargs
+
+    from howtotrainyourmamlpytorch_tpu.cli import main as cli_main
+
+    kwargs = worker_config_kwargs(
+        data_root=args.data_root,
+        exp_name=args.exp_name,
+        cache_dir=args.cache_dir,
+        total_epochs=args.total_epochs,
+        fault_spec=args.fault_spec,
+    )
+    argv = []
+    for key, value in kwargs.items():
+        argv += [f"--{key}", (
+            json.dumps(value) if isinstance(value, list)
+            else str(value).lower() if isinstance(value, bool)
+            else str(value)
+        )]
+    cli_main(argv)
+    print(f"WORKER_DONE process={jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

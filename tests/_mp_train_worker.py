@@ -43,6 +43,19 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if args.num_processes > 1:
+        # cross-process collectives on the CPU backend need an explicit
+        # implementation (the default 'none' client rejects multiprocess
+        # computations); gloo-over-TCP ships in jaxlib and rides the same
+        # coordination service jax.distributed.initialize sets up
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # gloo cannot tolerate CONCURRENT collectives on one TCP pair: the
+        # one-step-lag pipeline keeps a dispatch in flight while the next
+        # is enqueued, and two overlapping all-reduces race the pair's
+        # preamble ("op.preamble.length <= op.nbytes" aborts, ~1 in 3
+        # runs). Inline dispatch serializes device programs, which is the
+        # correct-first choice for a CPU test rig anyway.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
 
     from howtotrainyourmamlpytorch_tpu.cli import main as cli_main
 

@@ -1,0 +1,630 @@
+"""Unit coverage for the elastic layer: the topology-invariant episode
+schedule, the drain coordinator's file protocol, the bounded checkpoint
+barriers, the sharded-store gather, and topology-changing resume through
+the builder (the in-process halves of what ``test_elastic_e2e.py`` proves
+across real process boundaries)."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    DrainCoordinator,
+    elastic,
+    faults,
+)
+
+
+# -- the pure episode schedule -----------------------------------------------
+
+
+def test_shard_slice_partitions_every_batch_exactly():
+    for num_shards in (1, 2, 3, 6):
+        slices = [elastic.shard_slice(6, s, num_shards)
+                  for s in range(num_shards)]
+        covered = [i for lo, hi in slices for i in range(lo, hi)]
+        assert covered == list(range(6))  # block partition, order-preserving
+
+
+def test_shard_slice_rejects_bad_topology():
+    with pytest.raises(ValueError, match="re-partition"):
+        elastic.shard_slice(6, 0, 4)
+    with pytest.raises(ValueError, match="out of range"):
+        elastic.shard_slice(6, 3, 3)
+
+
+def test_process_for_index_inverts_shard_slice():
+    for num_shards in (1, 2, 3):
+        for g in range(18):
+            p = elastic.process_for_index(g, 6, num_shards)
+            lo, hi = elastic.shard_slice(6, p, num_shards)
+            assert lo <= g % 6 < hi
+
+
+def test_episode_cursor_is_pure_in_iteration():
+    assert elastic.episode_cursor_for_iter(0, 6) == 0
+    assert elastic.episode_cursor_for_iter(7, 6) == 42
+
+
+# -- the drain coordinator's file protocol ------------------------------------
+
+
+def _pair(tmp_path, margin=3):
+    d = str(tmp_path / "elastic")
+    return (
+        DrainCoordinator(d, 0, 2, margin_iters=margin),
+        DrainCoordinator(d, 1, 2, margin_iters=margin),
+    )
+
+
+def test_drain_request_commit_ack_roundtrip(tmp_path):
+    primary, worker = _pair(tmp_path)
+    # nothing published: polls are None on both sides
+    assert primary.poll(3) is None and worker.poll(3) is None
+    # the signalled (non-primary) worker publishes a request...
+    assert worker.request_drain(signal.SIGTERM, 5) is True
+    assert worker.request_drain(signal.SIGTERM, 5) is False  # idempotent
+    assert worker.poll(5) is None  # only the primary can commit
+    # ...the primary's next boundary poll promotes it to a commit
+    commit = primary.poll(6)
+    assert commit["drain_iter"] == 6 + 3
+    assert commit["signal"] == signal.SIGTERM
+    assert commit["requested_by"] == 1
+    assert commit["requested_at_iter"] == 5
+    # both sides refuse to drain before the agreed iteration...
+    assert primary.should_drain(8) is None
+    assert worker.poll(7) == commit  # observed through the filesystem
+    assert worker.should_drain(8) is None
+    # ...and drain exactly at it
+    assert primary.should_drain(9) == commit
+    assert worker.should_drain(9) == commit
+
+
+def test_primary_own_signal_commits_directly(tmp_path):
+    primary, worker = _pair(tmp_path, margin=2)
+    primary.request_drain(signal.SIGINT, 4)
+    commit = primary.poll(4)
+    assert commit["drain_iter"] == 6 and commit["requested_by"] == 0
+    assert worker.poll(5)["drain_iter"] == 6
+
+
+def test_drain_overshoot_drains_immediately_with_warning(tmp_path, capsys):
+    primary, worker = _pair(tmp_path, margin=1)
+    primary.request_drain(signal.SIGTERM, 2)
+    commit = primary.poll(2)  # drain_iter = 3
+    # the worker first observes the commit PAST the agreed iteration
+    assert worker.should_drain(5) == commit
+    assert "overshot" in capsys.readouterr().err
+
+
+def test_partial_commit_file_is_ignored_until_complete(tmp_path):
+    primary, worker = _pair(tmp_path)
+    os.makedirs(worker.coord_dir, exist_ok=True)
+    with open(worker.commit_path, "w") as f:
+        f.write('{"drain_iter": 9')  # torn write (no atomic rename used)
+    assert worker.poll(4) is None
+
+
+def test_stale_drain_files_never_preempt_a_resumed_run(tmp_path):
+    """A consumed (or crash-stranded) drain from a previous incarnation of
+    the experiment must not drain the resumed run: coordination files are
+    run-tagged by the resume iteration, and a same-tag re-resume is swept
+    by the primary's construction."""
+    d = str(tmp_path / "elastic")
+    old_primary = DrainCoordinator(d, 0, 2, run_tag="i0")
+    old_worker = DrainCoordinator(d, 1, 2, run_tag="i0")
+    old_worker.request_drain(signal.SIGTERM, 5)
+    assert old_primary.poll(6) is not None  # committed, then the gang died
+    # the resumed incarnation (from the iter-9 emergency) sees nothing
+    new_primary = DrainCoordinator(d, 0, 2, run_tag="i9")
+    new_worker = DrainCoordinator(d, 1, 2, run_tag="i9")
+    assert new_primary.poll(9) is None
+    assert new_worker.poll(9) is None
+    # even a re-resume from the SAME iteration is safe: the primary's
+    # construction sweeps its own tag's leftovers
+    swept = DrainCoordinator(d, 0, 2, run_tag="i0")
+    assert swept.poll(9) is None
+    assert not os.path.exists(swept.commit_path)
+
+
+def test_cached_stale_commit_dropped_when_sweep_wins(tmp_path):
+    """A follower whose first poll cached a previous same-tag
+    incarnation's commit BEFORE the primary's construction-time sweep must
+    not drain on it: should_drain re-validates against the filesystem and
+    forgets a commit whose file the sweep removed."""
+    d = str(tmp_path / "elastic")
+    old_primary = DrainCoordinator(d, 0, 2, run_tag="i0", margin_iters=1)
+    old_primary.request_drain(signal.SIGTERM, 3)
+    assert old_primary.poll(3) is not None  # stranded commit (gang died)
+    # the re-resumed follower polls FIRST and caches the stale commit...
+    follower = DrainCoordinator(d, 1, 2, run_tag="i0")
+    assert follower.poll(4) is not None
+    # ...then the primary's construction sweeps the leftovers
+    DrainCoordinator(d, 0, 2, run_tag="i0")
+    # drain time: the cached commit is re-validated and dropped
+    assert follower.should_drain(9) is None
+    assert follower.poll(9) is None  # cache cleared for good
+
+
+def test_request_republished_after_primary_sweep(tmp_path):
+    """A request that lost the race against the primary's construction-
+    time sweep is re-asserted on the next boundary instead of silently
+    dropped."""
+    d = str(tmp_path / "elastic")
+    worker = DrainCoordinator(d, 1, 2, run_tag="i0")
+    worker.request_drain(signal.SIGTERM, 1)
+    DrainCoordinator(d, 0, 2, run_tag="i0")  # ctor sweep eats the request
+    assert not os.path.exists(worker.request_path)
+    assert worker.request_drain(signal.SIGTERM, 2) is True  # re-published
+    assert os.path.exists(worker.request_path)
+
+
+def test_drain_poll_is_a_fault_site(tmp_path):
+    primary, _ = _pair(tmp_path)
+    faults.install("drain_poll:raise@call=2")
+    try:
+        primary.poll(1)
+        with pytest.raises(RuntimeError, match="injected fault"):
+            primary.poll(2)
+    finally:
+        faults.uninstall()
+
+
+def test_new_fault_sites_validate_and_count():
+    parsed = faults.parse_fault_spec(
+        "barrier:oserror@call=1,drain_poll:raise@call=3x2"
+    )
+    assert [f.site for f in parsed] == ["barrier", "drain_poll"]
+    with pytest.raises(ValueError, match="sigterm is only valid"):
+        faults.parse_fault_spec("barrier:sigterm@call=1")
+
+
+# -- bounded checkpoint barriers ---------------------------------------------
+
+
+def test_barrier_timeout_error_names_phase_and_swap_path():
+    from howtotrainyourmamlpytorch_tpu.experiment.checkpoint import (
+        CheckpointBarrierTimeoutError,
+    )
+
+    err = CheckpointBarrierTimeoutError(
+        "swap", "/exp/saved_models/train_model_7", 600.0,
+        cause=TimeoutError("deadline"),
+    )
+    msg = str(err)
+    assert "swap" in msg and "train_model_7" in msg
+    assert "train_model_7.old" in msg and "train_model_7.tmp" in msg
+    assert "ckpt_follower_timeout_s" in msg
+
+
+def test_process_barrier_timeout_raises_diagnosable_error(monkeypatch):
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    class _Client:
+        def wait_at_barrier(self, barrier_id, timeout_in_ms):
+            raise RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+
+    from jax._src import distributed as jax_distributed
+
+    monkeypatch.setattr(jax_distributed.global_state, "client", _Client())
+    with pytest.raises(
+        ckpt.CheckpointBarrierTimeoutError, match="swap.*train_model_3"
+    ):
+        ckpt._process_barrier(
+            "swap_train_model_3", "/exp/train_model_3", 0.01, phase="swap"
+        )
+
+
+def test_process_barrier_is_a_fault_site(monkeypatch):
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    seen = []
+
+    class _Client:
+        def wait_at_barrier(self, barrier_id, timeout_in_ms):
+            seen.append((barrier_id, timeout_in_ms))
+
+    from jax._src import distributed as jax_distributed
+
+    monkeypatch.setattr(jax_distributed.global_state, "client", _Client())
+    faults.install("barrier:oserror@call=2")
+    try:
+        ckpt._process_barrier("swap_x", "/exp/x", 5.0, phase="swap")
+        with pytest.raises(OSError, match="injected fault"):
+            ckpt._process_barrier("swap_x", "/exp/x", 5.0, phase="swap")
+    finally:
+        faults.uninstall()
+    # unique per crossing + the configured bound in milliseconds
+    assert seen == [("ckpt_swap_x_1", 5000)]
+
+
+# -- loader: the global episode cursor + re-partition -------------------------
+
+
+def _loader_cfg(data_root, cache_dir, **overrides):
+    kwargs = dict(
+        experiment_name="elastic_loader_probe",
+        dataset_name="imagenet_synthetic_presplit",
+        dataset_path=str(data_root),
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        image_height=8, image_width=8, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=6,
+        total_iter_per_epoch=4, num_evaluation_tasks=6,
+        num_dataprovider_workers=2,
+        cache_dir=str(cache_dir), use_mmap_cache=True, seed=0,
+    )
+    kwargs.update(overrides)
+    return MAMLConfig(**kwargs)
+
+
+@pytest.fixture(scope="module")
+def loader_env(tmp_path_factory):
+    from test_resilience_e2e import _write_presplit_rgb
+
+    root = tmp_path_factory.mktemp("elastic_loader")
+    data_root = root / "imagenet_synthetic_presplit"
+    _write_presplit_rgb(str(data_root))
+    return str(data_root), str(root / "cache")
+
+
+def _collect_batches(loader, n):
+    out = []
+    for i, b in enumerate(loader.get_train_batches(total_batches=n)):
+        out.append([np.asarray(a) for a in b[:4]])
+        if i + 1 == n:
+            break
+    return out
+
+
+def test_sharded_loaders_reassemble_the_single_process_stream(loader_env):
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader,
+    )
+
+    data_root, cache_dir = loader_env
+    cfg = _loader_cfg(data_root, cache_dir)
+    whole = _collect_batches(
+        MetaLearningDataLoader(cfg, 0, cache_dir, shard_id=0, num_shards=1),
+        2,
+    )
+    for num_shards in (2, 3):
+        shards = [
+            _collect_batches(
+                MetaLearningDataLoader(
+                    cfg, 0, cache_dir, shard_id=s, num_shards=num_shards
+                ),
+                2,
+            )
+            for s in range(num_shards)
+        ]
+        for b in range(2):
+            for part in range(4):
+                reassembled = np.concatenate(
+                    [shards[s][b][part] for s in range(num_shards)], axis=0
+                )
+                # block partition: process-major concatenation IS the
+                # single-process global batch, bit for bit
+                np.testing.assert_array_equal(
+                    reassembled, whole[b][part]
+                )
+
+
+def test_mid_stream_cursor_resume_matches_uninterrupted(loader_env):
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader,
+    )
+
+    data_root, cache_dir = loader_env
+    cfg = _loader_cfg(data_root, cache_dir)
+    # uninterrupted single-shard stream: 4 batches
+    whole = _collect_batches(
+        MetaLearningDataLoader(cfg, 0, cache_dir, shard_id=0, num_shards=1),
+        4,
+    )
+    # "kill" after 2 iterations, resume on THREE shards from the
+    # checkpointed cursor: the tail of the stream re-partitions exactly
+    cursor = elastic.episode_cursor_for_iter(2, cfg.global_tasks_per_batch)
+    shards = [
+        _collect_batches(
+            MetaLearningDataLoader(
+                cfg, current_iter=2, cache_dir=cache_dir,
+                shard_id=s, num_shards=3, episode_cursor=cursor,
+            ),
+            2,
+        )
+        for s in range(3)
+    ]
+    for b in range(2):
+        for part in range(4):
+            reassembled = np.concatenate(
+                [shards[s][b][part] for s in range(3)], axis=0
+            )
+            np.testing.assert_array_equal(reassembled, whole[2 + b][part])
+
+
+def test_cursor_mismatch_names_the_batch_size_drift(loader_env):
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader,
+    )
+
+    data_root, cache_dir = loader_env
+    cfg = _loader_cfg(data_root, cache_dir)
+    with pytest.raises(ValueError, match="episode cursor"):
+        MetaLearningDataLoader(
+            cfg, current_iter=2, cache_dir=cache_dir,
+            shard_id=0, num_shards=1,
+            episode_cursor=5,  # != 2 * 6
+        )
+
+
+def test_indivisible_elastic_topology_fails_loudly(loader_env):
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader,
+    )
+
+    data_root, cache_dir = loader_env
+    cfg = _loader_cfg(data_root, cache_dir)
+    with pytest.raises(ValueError, match="re-partition"):
+        MetaLearningDataLoader(
+            cfg, 0, cache_dir, shard_id=0, num_shards=4
+        )
+
+
+# -- topology-changing resume through the builder (satellite: peek/latest) ----
+
+
+@pytest.mark.slow
+def test_resume_prefers_newer_emergency_and_records_topology_change(
+    loader_env, tmp_path,
+):
+    """A checkpoint gang of 4 processes wrote `latest` (iter 4) and a NEWER
+    preemption emergency (iter 6); resuming on THIS single process must
+    pick the emergency (peek compares iters without a restore), consume
+    its episode cursor, and emit the elastic resume record old=4 -> new=1."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader,
+    )
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+    from howtotrainyourmamlpytorch_tpu.experiment.builder import (
+        ExperimentBuilder,
+    )
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    data_root, cache_dir = loader_env
+    exp_root = str(tmp_path)
+    cfg = _loader_cfg(
+        data_root, cache_dir,
+        experiment_name=os.path.join(exp_root, "topo_resume"),
+        total_epochs=2, telemetry_level="scalars",
+        compilation_cache_dir="",
+        total_epochs_before_pause=100,
+    )
+    state = maml.init_state(cfg)
+    saved = os.path.join(exp_root, "topo_resume", "saved_models")
+    os.makedirs(saved, exist_ok=True)
+    tpb = cfg.global_tasks_per_batch
+    base = {"best_val_acc": 0.0, "best_val_iter": 0,
+            "per_epoch_statistics": {"val_accuracy_mean": [0.5]}}
+    ckpt.save_checkpoint(
+        saved, "train_model", "latest", state,
+        {**base, "current_iter": 4, "process_count": 4,
+         "episode_cursor": 4 * tpb},
+    )
+    ckpt.save_checkpoint(
+        saved, "train_model", "emergency", state,
+        {**base, "current_iter": 6, "process_count": 4,
+         "episode_cursor": 6 * tpb, "emergency_reason": "preemption",
+         "preempt_signal": int(signal.SIGTERM)},
+    )
+    # peek is enough to rank the candidates — no array restore
+    assert ckpt.peek_experiment_state(
+        saved, "train_model", "emergency"
+    )["process_count"] == 4
+
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    builder = ExperimentBuilder(
+        cfg, model, MetaLearningDataLoader,
+        experiment_root=exp_root, verbose=False,
+    )
+    assert builder.state["current_iter"] == 6  # the newer emergency won
+    assert builder.data.total_train_iters_produced == 6 * tpb
+    builder.telemetry.close()
+
+    records = []
+    log = os.path.join(exp_root, "topo_resume", "logs", "telemetry.jsonl")
+    with open(log) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    (resume,) = [
+        r for r in records
+        if r["kind"] == "elastic" and r["event"] == "resume"
+    ]
+    assert resume["old_process_count"] == 4
+    assert resume["new_process_count"] == jax.process_count() == 1
+    assert resume["iter"] == 6
+    assert resume["episode_cursor"] == 6 * tpb
+
+
+# -- sharded resident stores --------------------------------------------------
+
+
+def _store_cfg(**overrides):
+    kwargs = dict(
+        dataset_name="imagenet_sharded_probe",
+        use_mmap_cache=True,
+        data_placement="device",
+        store_sharding="hosts",
+        image_height=6, image_width=6, image_channels=3,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=8,
+        cnn_num_filters=4, num_stages=1, max_pooling=True,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        use_remat=False, seed=0,
+    )
+    kwargs.update(overrides)
+    return MAMLConfig(**kwargs)
+
+
+def test_pad_store_rows_only_when_needed():
+    from howtotrainyourmamlpytorch_tpu.ops.device_pipeline import (
+        pad_store_rows,
+    )
+
+    store = np.arange(10 * 2, dtype=np.uint8).reshape(10, 2)
+    assert pad_store_rows(store, 2) is store
+    padded = pad_store_rows(store, 4)
+    assert padded.shape == (12, 2)
+    np.testing.assert_array_equal(padded[:10], store)
+    assert not padded[10:].any()
+
+
+@pytest.fixture(scope="module")
+def hybrid_mesh():
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.parallel import distributed
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return distributed.hybrid_task_mesh(processes=2)
+
+
+@pytest.mark.slow
+def test_sharded_store_gather_bit_exact_and_batch_sized_collectives(
+    hybrid_mesh,
+):
+    """The masked-gather + hosts-psum expansion must reproduce the
+    replicated gather bit-for-bit (exactly one shard contributes per row)
+    while its collectives stay BATCH-sized float32 — never store-sized,
+    never uint8 (the PR 8 SPMD residency invariants)."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.analysis import contracts
+    from howtotrainyourmamlpytorch_tpu.ops import device_pipeline as dp
+    from howtotrainyourmamlpytorch_tpu.parallel import distributed
+
+    cfg = _store_cfg()
+    rng = np.random.RandomState(0)
+    # store >> batch so "batch-sized" and "store-sized" are distinguishable
+    store = rng.randint(0, 256, (4096, 6, 6, 3), dtype=np.uint8)
+    gather = rng.randint(0, 4096, (8, 2, 2)).astype(np.int32)
+    rot_k = np.zeros((8, 2), np.int32)
+
+    expand_rep = dp.make_index_expander(cfg, augment=False)
+    expand_sh = dp.make_index_expander(
+        cfg, augment=False, store_mesh=hybrid_mesh
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    store_rep = jax.device_put(store, NamedSharding(hybrid_mesh, P()))
+    store_sh = jax.device_put(
+        dp.pad_store_rows(store, 2),
+        distributed.store_row_sharding(hybrid_mesh),
+    )
+    batch_sharding = distributed.global_batch_sharding(hybrid_mesh)
+    g = jax.device_put(gather, batch_sharding)
+    rk = jax.device_put(rot_k, batch_sharding)
+
+    out_rep = jax.jit(expand_rep)(store_rep, g, rk)
+    out_sh = jax.jit(expand_sh)(store_sh, g, rk)
+    for a, b in zip(out_rep, out_sh):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    hlo = jax.jit(expand_sh).lower(store_sh, g, rk).compile().as_text()
+    colls = contracts.collective_instructions(hlo)
+    assert colls, "sharded gather must use a hosts-axis collective"
+    batch_bytes = 8 * 2 * 2 * 6 * 6 * 3 * 4  # decoded f32 batch
+    for c in colls:
+        assert c["bytes"] <= batch_bytes, c
+        assert c["bytes"] < store.nbytes // 4, c
+        assert "u8[" not in c["shape"], f"uint8 pixels crossed the mesh: {c}"
+
+
+@pytest.mark.slow
+def test_system_facade_places_and_gathers_sharded_stores(hybrid_mesh):
+    """store_sharding='hosts' through MAMLFewShotClassifier: the resident
+    store lands row-sharded over the hosts axis, the indexed eval runs,
+    and per-task predictions equal the replicated-store run's exactly."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.data.loader import IndexBatch
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        distributed,
+        mesh as mesh_lib,
+    )
+
+    rng = np.random.RandomState(1)
+    store = rng.randint(0, 256, (64, 6, 6, 3), dtype=np.uint8)
+    batch = IndexBatch(
+        gather=rng.randint(0, 64, (8, 2, 2)).astype(np.int32),
+        rot_k=np.zeros((8, 2), np.int32),
+        seeds=np.arange(8, dtype=np.int64),
+        set_name="val",
+        augment=False,
+    )
+
+    def build(sharding):
+        model = MAMLFewShotClassifier(
+            _store_cfg(store_sharding=sharding), use_mesh=False
+        )
+        # simulate the pod's hybrid mesh on one process (tests' standard
+        # trick — distributed.hybrid_task_mesh(processes=2)), then
+        # re-resolve the sharding decision against it
+        model.mesh = hybrid_mesh
+        model.state = mesh_lib.replicate_state(hybrid_mesh, model.state)
+        model._resolve_store_sharding()
+        model.register_flat_stores({"val": store})
+        return model
+
+    sharded = build("hosts")
+    assert sharded._store_mesh is hybrid_mesh
+    m_sh, p_sh = sharded.run_validation_iter(batch, return_preds=True)
+    arr = sharded._device_stores["val"]
+    assert arr.sharding.spec == distributed.store_row_sharding(
+        hybrid_mesh
+    ).spec
+    # each device holds 1/2 of the rows (sharded over hosts, replicated
+    # over its row's task axis)
+    assert arr.addressable_shards[0].data.shape[0] == store.shape[0] // 2
+
+    replicated = build("replicated")
+    assert replicated._store_mesh is None
+    m_rep, p_rep = replicated.run_validation_iter(batch, return_preds=True)
+    # the GATHER itself is bit-exact (proved at the expander level above);
+    # through the whole eval step this simulated-mesh harness compares a
+    # 4-way-sharded compute (the replicated arm's 1-D index sharding) with
+    # an 8-way one (the sharded arm's batch constraint), so downstream conv
+    # tiling may differ in the last ULP — real multihost runs shard both
+    # arms identically (global_batch_sharding) and keep bit-identity
+    np.testing.assert_allclose(p_sh, p_rep, rtol=1e-6, atol=1e-7)
+    for key in m_rep:
+        np.testing.assert_allclose(
+            np.asarray(m_sh[key]), np.asarray(m_rep[key]),
+            rtol=1e-6, atol=1e-6, err_msg=key,
+        )
+
+
+def test_store_sharding_degrades_to_replicated_off_hybrid_mesh(capsys):
+    """A single-host (1-D task) mesh has no host axis: the knob degrades
+    to replication with a log line instead of mis-sharding."""
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    model = MAMLFewShotClassifier(_store_cfg(), use_mesh=True)
+    assert model._store_mesh is None
+    assert "stay replicated" in capsys.readouterr().out

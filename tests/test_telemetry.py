@@ -640,6 +640,36 @@ def test_v5_analysis_record_kind_validates():
         })
 
 
+def test_validate_file_accepts_v5_era_fixture():
+    """The pinned v5-era log (written before the v6 `elastic` kind
+    existed) validates unchanged under the v6 validator — the backward
+    half of the version contract: v6 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v5_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 7
+
+
+def test_v6_elastic_record_kind_validates():
+    """The schema v6 addition: `elastic` records (coordinated drain
+    protocol events + topology-change resume markers) built through the
+    sink's make_record pass strict validation; one missing its required
+    field is rejected."""
+    tel.validate_record(tel.make_record(
+        "elastic", event="drain_commit", iter=6, drain_iter=8,
+        signal=15, requested_by=1,
+    ))
+    tel.validate_record(tel.make_record(
+        "elastic", event="resume", old_process_count=2,
+        new_process_count=3, iter=4, episode_cursor=24,
+    ))
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "elastic",
+            "iter": 6,
+        })
+
+
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------
 
 

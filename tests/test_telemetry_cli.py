@@ -286,3 +286,45 @@ def test_summary_without_retraces_prints_no_analysis_line(tmp_path, capsys):
     log = _write_log(tmp_path / "t.jsonl", _run_records([0.5]))
     assert cli_main(["summary", log]) == 0
     assert "mid-run retrace" not in capsys.readouterr().out
+
+
+def test_summary_surfaces_elastic_drain_and_resume_line(tmp_path, capsys):
+    """Schema v6: `summary` condenses the `elastic` records — drain
+    protocol progress and the last topology-change resume (old -> new
+    process count + episode cursor) — into the elastic line (jax-free)."""
+    records = _run_records([0.5])
+    for rec in (
+        make_record("elastic", event="drain_request", iter=5, signal=15),
+        make_record("elastic", event="drain_commit", iter=6, drain_iter=8,
+                    signal=15, requested_by=1),
+        make_record("elastic", event="drain_ack", iter=8, drain_iter=8),
+        make_record("elastic", event="resume", old_process_count=2,
+                    new_process_count=3, iter=8, episode_cursor=48),
+    ):
+        records.insert(-1, rec)
+    log = _write_log(tmp_path / "t.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["elastic"]["drain_requests"] == 1
+    assert payload["elastic"]["drain_commits"] == 1
+    assert payload["elastic"]["drain_acks"] == 1
+    assert payload["elastic"]["resumes"] == 1
+    assert payload["elastic"]["last_resume"] == {
+        "old_process_count": 2, "new_process_count": 3, "iter": 8,
+        "episode_cursor": 48,
+    }
+    assert cli_main(["summary", log]) == 0
+    out = capsys.readouterr().out
+    assert (
+        "elastic: 1 drain request(s), 1 commit(s), 1 ack(s), "
+        "1 elastic resume(s)" in out
+    )
+    assert "last resume 2 -> 3 process(es) @ iter 8 (episode cursor 48)" in out
+
+
+def test_summary_without_elastic_records_omits_elastic_line(tmp_path, capsys):
+    log = _write_log(tmp_path / "t.jsonl", _run_records([0.5]))
+    assert cli_main(["summary", log, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["elastic"] is None
+    assert cli_main(["summary", log]) == 0
+    assert "elastic:" not in capsys.readouterr().out
