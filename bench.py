@@ -497,7 +497,7 @@ _WORKLOAD_KNOBS = (
     "BENCH_NUMBER_OF_EVALUATION_STEPS_PER_ITER",
     "BENCH_COMPUTE_DTYPE", "BENCH_USE_REMAT", "BENCH_REMAT_POLICY",
     "BENCH_CONV_IMPL", "BENCH_POOL_IMPL", "BENCH_TASK_AXIS_MODE",
-    "BENCH_PAD_CHANNELS",
+    "BENCH_PAD_CHANNELS", "BENCH_META_ACCUM_STEPS",
 )
 
 # The hlo_cost / donation helpers (cost-analysis normalization, optimized-
@@ -559,6 +559,12 @@ def main() -> None:
     if "BENCH_PAD_CHANNELS" in os.environ:
         # 'auto' | 'off' | 'tile' | integer multiple (config validates)
         overrides["pad_channels"] = os.environ["BENCH_PAD_CHANNELS"]
+    if "BENCH_META_ACCUM_STEPS" in os.environ:
+        # task-microbatched gradient accumulation inside the step (must
+        # divide the batch — clamped below once the batch is known)
+        overrides["meta_accum_steps"] = int(
+            os.environ["BENCH_META_ACCUM_STEPS"]
+        )
     if "BENCH_USE_REMAT" in os.environ:
         raw = os.environ["BENCH_USE_REMAT"].lower()
         if raw not in ("true", "false", "0", "1"):
@@ -568,6 +574,22 @@ def main() -> None:
     # batch on TPU, 8/chip elsewhere
     per_chip = _TPU_TASKS_PER_CHIP if backend == "tpu" else 8
     overrides.setdefault("batch_size", per_chip * n_chips)
+    # accumulation must divide the batch: clamp a sweep-point accum down
+    # to the largest divisor (a 2-task reduced run with accum=4 measures
+    # accum=2 and SAYS so in the emitted line) instead of refusing to
+    # emit a parsable line
+    if overrides.get("meta_accum_steps", 1) > 1:
+        accum = min(overrides["meta_accum_steps"], overrides["batch_size"])
+        while overrides["batch_size"] % accum != 0:
+            accum -= 1
+        if accum != overrides["meta_accum_steps"]:
+            print(
+                f"bench: meta_accum_steps={overrides['meta_accum_steps']} "
+                f"does not divide batch {overrides['batch_size']}; "
+                f"clamped to {accum}",
+                file=sys.stderr,
+            )
+        overrides["meta_accum_steps"] = accum
     cfg = _flagship_cfg(**overrides)
     state = maml.init_state(cfg)
     b = cfg.batch_size
@@ -764,6 +786,7 @@ def main() -> None:
         "conv_impl": cfg.resolved_conv_impl,
         "pool_impl": cfg.resolved_pool_impl,
         "pad_channels": cfg.resolved_pad_channels,
+        "meta_accum_steps": cfg.meta_accum_steps,
         "task_axis_mode": cfg.task_axis_mode,
         "use_remat": cfg.use_remat,
         "remat_policy": cfg.remat_policy if cfg.use_remat else None,
@@ -818,8 +841,8 @@ def main() -> None:
     # defaults landed) is stale, not a comparison point.
     _COMPARABLE_KEYS = (
         "backend", "dtype", "batch_size", "n_chips", "conv_impl",
-        "pool_impl", "pad_channels", "task_axis_mode", "use_remat",
-        "remat_policy", "matmul_precision", "workload",
+        "pool_impl", "pad_channels", "meta_accum_steps", "task_axis_mode",
+        "use_remat", "remat_policy", "matmul_precision", "workload",
     )
     comparable = (
         baseline_rec is not None

@@ -20,7 +20,13 @@ The layers, by import weight:
   performance contracts: sharding, per-axis collective census, static
   per-device HBM budget, roofline;
 * :mod:`analysis.lint` (stdlib-only, AST-based) — repo-specific
-  traced-code pitfall checkers, runnable on a machine without jax.
+  traced-code pitfall checkers, runnable on a machine without jax;
+* :mod:`analysis.autotune` (stdlib-only; the sweep shells out to
+  bench.py) — the roofline-driven step autotuner behind ``cli tune``:
+  sweeps the lowering knob grid, ranks by measured step time
+  cross-checked against the roofline predictions, and writes the
+  device-kind-keyed ``TUNING.json`` that ``config``'s ``'auto'``
+  resolution consults.
 
 ``cfg.analysis_level`` gates everything: ``'off'`` (default) installs
 nothing and the jitted programs are bit-identical to a pre-analysis build

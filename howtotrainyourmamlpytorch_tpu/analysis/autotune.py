@@ -1,0 +1,533 @@
+"""Roofline-driven step autotuner: measure, rank, pin the fast lowering.
+
+ROADMAP item 2's gap had a mechanical cause: the lowering knobs that
+decide whether the train step saturates the MXU (``conv_impl``,
+``pad_channels``, ``remat_policy``, and — since this PR —
+``meta_accum_steps``) were resolved by *heuristics*, and the heuristics
+lost quietly (BENCH_BASELINE.json records ``conv_impl='lax'`` at 2.5%
+MFU on a machine where the gemm path existed). This module replaces the
+guess with a measurement:
+
+* ``cli tune`` sweeps the knob grid with ``bench.py``'s harness (one
+  subprocess per point — the same timed-step protocol, donation and
+  tunnel-proof sync as the longitudinal bench line), ranks the points by
+  measured ``meta_tasks_per_sec_per_chip``, cross-checks the ranking
+  against the static roofline predictions each bench line carries
+  (``analysis/roofline.py`` — a point whose measurement and prediction
+  disagree about the winner is flagged, not silently trusted), and
+  writes a **device-kind-keyed tuning table** (``TUNING.json``);
+* ``config.resolved_conv_impl`` / ``resolved_pad_channels`` consult the
+  table under ``'auto'``: the measured winner for this device kind +
+  compute dtype becomes the default, with the PR-4 heuristic as the
+  fallback when no table (or no entry) exists.
+
+The table half is deliberately stdlib-only (config imports it on every
+``'auto'`` resolution; the sweep half shells out to ``bench.py`` so jax
+state never leaks between points — every point compiles in a pristine
+process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+TUNING_VERSION = 1
+
+#: env var overriding the table location (tests point it at tmp files;
+#: operators can ship a pod-wide table without touching the checkout)
+TUNING_TABLE_ENV = "MAML_TUNING_TABLE"
+
+#: the swept knobs, in the order they appear in point labels
+SWEEP_KNOBS: Tuple[str, ...] = (
+    "conv_impl", "pad_channels", "remat_policy", "meta_accum_steps",
+)
+
+_VALID_CONV_IMPL = ("lax", "im2col", "gemm")
+_VALID_PAD = ("off", "tile")
+_VALID_REMAT = ("full", "save_conv")
+
+
+def default_table_path() -> str:
+    """``$MAML_TUNING_TABLE`` when set, else ``TUNING.json`` at the repo
+    root (next to CONTRACTS.json / BENCH_BASELINE.json)."""
+    env = os.environ.get(TUNING_TABLE_ENV)
+    if env:
+        return env
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "TUNING.json",
+    )
+
+
+def table_key(device_kind: str, dtype: str) -> str:
+    """Entries are keyed ``<device_kind>@<compute_dtype>`` — the same pair
+    that keys the roofline peak table, so one host never reads another
+    accelerator generation's tuning."""
+    return f"{device_kind}@{dtype}"
+
+
+def validate_tuning_table(data: Any) -> None:
+    """Raise ``ValueError`` unless ``data`` is a structurally valid tuning
+    table (what the CI ``cli tune --fast`` gate asserts)."""
+    if not isinstance(data, dict):
+        raise ValueError("tuning table must be a JSON object")
+    if data.get("version") != TUNING_VERSION:
+        raise ValueError(
+            f"tuning table version {data.get('version')!r} != "
+            f"{TUNING_VERSION}"
+        )
+    entries = data.get("entries")
+    if not isinstance(entries, dict) or not entries:
+        raise ValueError("tuning table has no 'entries' mapping")
+    for key, entry in entries.items():
+        if "@" not in key:
+            raise ValueError(
+                f"entry key {key!r} is not '<device_kind>@<dtype>'"
+            )
+        if not isinstance(entry, dict):
+            raise ValueError(f"entry {key!r} is not an object")
+        if entry.get("conv_impl") not in _VALID_CONV_IMPL:
+            raise ValueError(
+                f"entry {key!r}: conv_impl {entry.get('conv_impl')!r} "
+                f"not in {_VALID_CONV_IMPL}"
+            )
+        pad = entry.get("pad_channels")
+        if not (
+            pad in _VALID_PAD
+            or (isinstance(pad, int) and not isinstance(pad, bool) and pad > 0)
+        ):
+            raise ValueError(
+                f"entry {key!r}: pad_channels {pad!r} must be 'off', "
+                "'tile' or a positive int"
+            )
+        if entry.get("remat_policy") not in _VALID_REMAT:
+            raise ValueError(
+                f"entry {key!r}: remat_policy "
+                f"{entry.get('remat_policy')!r} not in {_VALID_REMAT}"
+            )
+        accum = entry.get("meta_accum_steps")
+        if not (
+            isinstance(accum, int) and not isinstance(accum, bool)
+            and accum >= 1
+        ):
+            raise ValueError(
+                f"entry {key!r}: meta_accum_steps {accum!r} must be an "
+                "int >= 1"
+            )
+        tps = entry.get("tasks_per_sec_per_chip")
+        if not isinstance(tps, (int, float)) or isinstance(tps, bool) or (
+            tps <= 0
+        ):
+            raise ValueError(
+                f"entry {key!r}: tasks_per_sec_per_chip {tps!r} must be a "
+                "positive number"
+            )
+
+
+# the table is consulted inside config property resolution, which runs
+# during program tracing — memoize by (path, mtime) so a trace pays one
+# stat, not one parse, per consult
+_TABLE_CACHE: Dict[str, Tuple[float, Optional[dict]]] = {}
+
+
+def load_tuning_table(path: Optional[str] = None) -> Optional[dict]:
+    """The parsed tuning table, or None when absent/unreadable/invalid.
+    Never raises: a corrupt table degrades to the heuristics with a
+    one-line stderr note, it must not take training down."""
+    path = path or default_table_path()
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    cached = _TABLE_CACHE.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    data: Optional[dict] = None
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        validate_tuning_table(loaded)
+        data = loaded
+    except (OSError, ValueError) as e:
+        print(
+            f"[autotune] ignoring invalid tuning table {path}: {e}",
+            file=sys.stderr,
+        )
+    _TABLE_CACHE[path] = (mtime, data)
+    return data
+
+
+def tuned_entry(
+    device_kind: str, dtype: str, table: Optional[dict] = None,
+    path: Optional[str] = None,
+) -> Optional[dict]:
+    """The tuning entry for (device kind, compute dtype), or None. Exact
+    key match first, then a case-insensitive substring match on the device
+    kind (the same relaxed matching the roofline peak table uses — a table
+    pinned on 'TPU v5 lite' serves a host reporting 'TPU v5 litepod')."""
+    if table is None:
+        table = load_tuning_table(path)
+    if table is None:
+        return None
+    entries = table.get("entries", {})
+    exact = entries.get(table_key(device_kind, dtype))
+    if exact is not None:
+        return exact
+    kind = (device_kind or "").lower()
+    for key, entry in entries.items():
+        entry_kind, _, entry_dtype = key.rpartition("@")
+        if entry_dtype == dtype and entry_kind.lower() in kind and entry_kind:
+            return entry
+    return None
+
+
+def clear_cache() -> None:
+    """Drop the memoized tables (tests rewrite table files in place)."""
+    _TABLE_CACHE.clear()
+
+
+# -- the sweep ---------------------------------------------------------------
+
+
+def sweep_points(fast: bool = False) -> List[Dict[str, Any]]:
+    """The knob grid ``cli tune`` measures.
+
+    ``fast`` (the CI smoke): 2 points that still cross every axis once —
+    enough to prove the harness end to end without a grid of bench runs.
+    Full: conv_impl x pad_channels x remat_policy x meta_accum_steps —
+    the grid ROADMAP item 2 names (36 points; each is one reduced bench
+    run, so the full sweep is an hours-scale hardware session, which is
+    the point: measured once per device generation, consulted forever).
+    """
+    if fast:
+        return [
+            {"conv_impl": "gemm", "pad_channels": "tile",
+             "remat_policy": "save_conv", "meta_accum_steps": 1},
+            {"conv_impl": "im2col", "pad_channels": "off",
+             "remat_policy": "full", "meta_accum_steps": 2},
+        ]
+    points = []
+    conv_impls = ["lax", "gemm", "im2col"]
+    for conv_impl in conv_impls:
+        for pad in ("off", "tile"):
+            for remat in ("full", "save_conv"):
+                for accum in (1, 2, 4):
+                    points.append({
+                        "conv_impl": conv_impl,
+                        "pad_channels": pad,
+                        "remat_policy": remat,
+                        "meta_accum_steps": accum,
+                    })
+    return points
+
+
+def point_label(point: Dict[str, Any]) -> str:
+    return ",".join(f"{k}={point[k]}" for k in SWEEP_KNOBS)
+
+
+#: sub-measurements every sweep point skips — points rank train-step
+#: throughput only, exactly like bench_sweep
+_SWEEP_ENV = {
+    "BENCH_NO_BASELINE_WRITE": "1",
+    "BENCH_SKIP_EPOCH_BOUNDARY": "1",
+    "BENCH_SKIP_INPUT_PIPELINE": "1",
+    "BENCH_SKIP_TELEMETRY_OVERHEAD": "1",
+    "BENCH_SKIP_HEALTH_OVERHEAD": "1",
+}
+
+#: tiny-workload knobs for --fast (CI runs this on a CPU runner; the
+#: point is a valid table, not a meaningful number)
+_FAST_ENV = {
+    "BENCH_WARMUP_STEPS": "1",
+    "BENCH_TIMED_STEPS": "2",
+    "BENCH_BATCH_SIZE": "2",
+    "BENCH_CNN_NUM_FILTERS": "8",
+    "BENCH_IMAGE_HEIGHT": "16",
+    "BENCH_IMAGE_WIDTH": "16",
+    "BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER": "2",
+    "BENCH_NUMBER_OF_EVALUATION_STEPS_PER_ITER": "2",
+}
+
+
+def bench_script_path() -> str:
+    """``bench.py`` at the repo root (next to this package)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "bench.py",
+    )
+
+
+def run_bench_point(
+    point: Dict[str, Any],
+    fast: bool = False,
+    timeout_s: float = 1800.0,
+    extra_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """One sweep point = one ``bench.py`` subprocess with the point's
+    knobs as BENCH_* env vars. Returns the parsed bench line (raises
+    ``RuntimeError`` naming the point on a non-zero exit / unparsable
+    output)."""
+    env = dict(os.environ)
+    env.update(_SWEEP_ENV)
+    if fast:
+        env.update(_FAST_ENV)
+    env["BENCH_CONV_IMPL"] = str(point["conv_impl"])
+    env["BENCH_PAD_CHANNELS"] = str(point["pad_channels"])
+    env["BENCH_REMAT_POLICY"] = str(point["remat_policy"])
+    env["BENCH_USE_REMAT"] = "true"
+    env["BENCH_META_ACCUM_STEPS"] = str(point["meta_accum_steps"])
+    if extra_env:
+        env.update(extra_env)
+    script = bench_script_path()
+    r = subprocess.run(
+        [sys.executable, script],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    label = point_label(point)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench point [{label}] exited {r.returncode}: "
+            f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ''}"
+        )
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(f"bench point [{label}] produced no output")
+    try:
+        rec = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        raise RuntimeError(
+            f"bench point [{label}] emitted an unparsable line: {e}"
+        ) from e
+    rec["point"] = dict(point)
+    return rec
+
+
+def measured_step_seconds(rec: Dict[str, Any]) -> Optional[float]:
+    """Wall seconds per dispatch implied by a bench line: batch tasks over
+    global tasks/s (value is per *working* chip)."""
+    value = rec.get("value")
+    batch = rec.get("batch_size")
+    chips = rec.get("n_chips") or 1
+    if not value or not batch:
+        return None
+    return float(batch) / (float(value) * float(chips))
+
+
+def cross_check_roofline(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Hold the measured ranking to the static roofline predictions the
+    bench lines carry: per point, measured vs predicted step seconds (and
+    their ratio); plus whether the measured winner is also the predicted
+    winner. Informational — a disagreement means the static model misses
+    something the hardware sees (or vice versa), which is exactly the
+    point worth a human look before the table is trusted on a pod."""
+    per_point = []
+    for rec in results:
+        roofline = rec.get("roofline") or {}
+        predicted = roofline.get("predicted_step_seconds")
+        measured = measured_step_seconds(rec)
+        per_point.append({
+            "label": point_label(rec["point"]),
+            "measured_step_s": measured,
+            "predicted_step_s": predicted,
+            "measured_over_predicted": (
+                round(measured / predicted, 3)
+                if measured and predicted else None
+            ),
+        })
+    by_measured = sorted(
+        (r for r in results if r.get("value")),
+        key=lambda r: -float(r["value"]),
+    )
+    with_pred = [
+        r for r in results
+        if (r.get("roofline") or {}).get("predicted_step_seconds")
+    ]
+    by_predicted = sorted(
+        with_pred,
+        key=lambda r: float(r["roofline"]["predicted_step_seconds"]),
+    )
+    agrees = None
+    if by_measured and by_predicted:
+        agrees = (
+            point_label(by_measured[0]["point"])
+            == point_label(by_predicted[0]["point"])
+        )
+    return {
+        "points": per_point,
+        "winner_agrees_with_roofline": agrees,
+        "predicted_winner": (
+            point_label(by_predicted[0]["point"]) if by_predicted else None
+        ),
+    }
+
+
+def build_table(
+    results: List[Dict[str, Any]],
+    existing: Optional[dict] = None,
+) -> dict:
+    """Fold sweep results into a tuning table: per (device_kind, dtype)
+    key, the measured-fastest point wins. MERGES with ``existing`` (same
+    discipline as CONTRACTS.json pinning: a CPU smoke sweep must never
+    clobber the TPU entry) — and a REDUCED sweep (the tiny-workload
+    ``--fast`` smoke) never replaces a full-workload entry for the same
+    key: the smoke proves the harness, the full measurement stays the
+    tuning."""
+    table: dict = {
+        "version": TUNING_VERSION,
+        "entries": dict((existing or {}).get("entries", {})),
+    }
+    best: Dict[str, Dict[str, Any]] = {}
+    for rec in results:
+        if not rec.get("value"):
+            continue
+        key = table_key(
+            str(rec.get("device_kind", "")), str(rec.get("dtype", ""))
+        )
+        if key not in best or float(rec["value"]) > float(
+            best[key]["value"]
+        ):
+            best[key] = rec
+    for key, rec in best.items():
+        prior = table["entries"].get(key)
+        if (
+            prior is not None
+            and rec.get("reduced")
+            and not prior.get("reduced")
+        ):
+            print(
+                f"[autotune] keeping the existing full-workload entry for "
+                f"{key}: this sweep ran the reduced workload",
+                file=sys.stderr,
+            )
+            continue
+        point = rec["point"]
+        table["entries"][key] = {
+            "conv_impl": point["conv_impl"],
+            "pad_channels": point["pad_channels"],
+            "remat_policy": point["remat_policy"],
+            # the accum bench.py ACTUALLY measured: it clamps a sweep
+            # point's accum to the largest batch divisor and reports the
+            # clamped value in the emitted line
+            "meta_accum_steps": int(
+                rec.get("meta_accum_steps", point["meta_accum_steps"])
+            ),
+            "tasks_per_sec_per_chip": float(rec["value"]),
+            "mfu": rec.get("mfu"),
+            "backend": rec.get("backend"),
+            "batch_size": rec.get("batch_size"),
+            "reduced": rec.get("reduced"),
+        }
+    return table
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``cli tune`` — sweep, rank, cross-check, write the table."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="tune",
+        description="Sweep (conv_impl x pad_channels x remat_policy x "
+                    "meta_accum_steps) with bench.py, rank by measured "
+                    "step time cross-checked against the static roofline, "
+                    "and write the device-kind-keyed tuning table that "
+                    "config 'auto' resolution consults",
+    )
+    parser.add_argument("--fast", action="store_true",
+                        help="2-point smoke sweep on a tiny workload (the "
+                             "CI gate; proves the harness, not the number)")
+    parser.add_argument("--out", default=None,
+                        help="tuning table path (default: TUNING.json at "
+                             "the repo root, or $MAML_TUNING_TABLE)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    parser.add_argument("--timeout-s", type=float, default=1800.0,
+                        help="per-point bench subprocess timeout")
+    args = parser.parse_args(argv)
+
+    out_path = args.out or default_table_path()
+    points = sweep_points(fast=args.fast)
+    results: List[Dict[str, Any]] = []
+    failures: List[str] = []
+    for i, point in enumerate(points):
+        label = point_label(point)
+        print(
+            f"tune: [{i + 1}/{len(points)}] {label} ...",
+            file=sys.stderr, flush=True,
+        )
+        try:
+            rec = run_bench_point(
+                point, fast=args.fast, timeout_s=args.timeout_s
+            )
+        except (RuntimeError, subprocess.TimeoutExpired) as e:
+            # an OOM/unsupported point is a sweep RESULT (that config
+            # doesn't fit this device), not a harness failure
+            print(f"tune: point failed: {e}", file=sys.stderr, flush=True)
+            failures.append(label)
+            continue
+        print(
+            f"tune:   -> {rec.get('value')} tasks/s/chip "
+            f"(mfu={rec.get('mfu')})",
+            file=sys.stderr, flush=True,
+        )
+        results.append(rec)
+    if not results:
+        print("tune: every sweep point failed; no table written",
+              file=sys.stderr)
+        return 1
+    check = cross_check_roofline(results)
+    existing = load_tuning_table(out_path)
+    table = build_table(results, existing=existing)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+    os.replace(tmp, out_path)
+    clear_cache()
+    ranked = sorted(results, key=lambda r: -float(r.get("value") or 0.0))
+    if args.json:
+        print(json.dumps({
+            "table_path": out_path,
+            "entries": table["entries"],
+            "ranking": [
+                {"label": point_label(r["point"]),
+                 "tasks_per_sec_per_chip": r.get("value"),
+                 "mfu": r.get("mfu")}
+                for r in ranked
+            ],
+            "roofline_cross_check": check,
+            "failed_points": failures,
+        }, indent=2, sort_keys=True))
+    else:
+        print(f"tune: ranking ({len(results)} point(s)"
+              + (f", {len(failures)} failed" if failures else "") + "):")
+        for r in ranked:
+            measured = measured_step_seconds(r)
+            step = f"step={measured * 1e3:.1f}ms  " if measured else ""
+            print(
+                f"  {r.get('value'):>10} tasks/s/chip  {step}"
+                f"[{point_label(r['point'])}]"
+            )
+        if check["winner_agrees_with_roofline"] is False:
+            print(
+                "tune: NOTE measured winner disagrees with the roofline-"
+                f"predicted winner ({check['predicted_winner']}) — trust "
+                "the measurement, but the static model missed something",
+            )
+        print(f"tune: wrote {out_path} "
+              f"({len(table['entries'])} device entr"
+              f"{'y' if len(table['entries']) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

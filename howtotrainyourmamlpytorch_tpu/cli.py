@@ -31,6 +31,17 @@ HBM budget / roofline) with the family compiled under a real hybrid
     python -m howtotrainyourmamlpytorch_tpu.cli audit [--pin]
     python -m howtotrainyourmamlpytorch_tpu.cli audit --mesh 1x8 [--pin]
 
+The ``tune`` subcommand (analysis/autotune.py) is the roofline-driven
+step autotuner: it sweeps (conv_impl x pad_channels x remat_policy x
+meta_accum_steps) with bench.py's harness (one subprocess per point),
+ranks the points by measured step time cross-checked against the static
+roofline predictions, and writes the device-kind-keyed ``TUNING.json``
+that ``config``'s ``'auto'`` resolution consults — making the measured
+winner the default lowering on that hardware:
+
+    python -m howtotrainyourmamlpytorch_tpu.cli tune
+    python -m howtotrainyourmamlpytorch_tpu.cli tune --fast --out /tmp/t.json
+
 Exit codes: 0 on success; ``resilience.PREEMPT_EXIT_CODE`` (75) when a
 SIGTERM/SIGINT preemption was drained gracefully (emergency checkpoint on
 disk — restart with ``continue_from_epoch=latest`` to resume at the exact
@@ -111,6 +122,13 @@ def main(argv=None):
         from .tools.audit_cli import main as audit_main
 
         raise SystemExit(audit_main(args[1:]))
+    if args and args[0] == "tune":
+        # roofline-driven step autotuner: jax-free in THIS process (every
+        # sweep point is a bench.py subprocess), so dispatch before the
+        # jax-heavy training imports below
+        from .analysis.autotune import main as tune_main
+
+        raise SystemExit(tune_main(args[1:]))
     from .data.loader import MetaLearningDataLoader
     from .experiment.builder import ExperimentBuilder
     from .experiment.system import MAMLFewShotClassifier

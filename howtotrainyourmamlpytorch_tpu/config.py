@@ -155,6 +155,18 @@ class MAMLConfig:
     # tile rule (tests/test_pad_channels.py) while every GEMM dimension
     # tiles cleanly
     pad_channels: Union[str, int] = "auto"
+    # task-microbatched meta-gradient accumulation: the train step scans the
+    # meta-batch in N microbatches of batch_size/N tasks INSIDE one compiled
+    # dispatch, stacking per-task meta-grads and reducing them once in f32 —
+    # the per-device activation peak of differentiating through the inner
+    # loop shrinks ~N-fold while the effective meta-batch (and the update
+    # math) is unchanged: the accumulated step reduces the same per-task
+    # values in the same order as the monolithic step, so results are
+    # bit-exact in f32 at equal total batch (tests/test_accum.py). 1 (the
+    # default) keeps the single-pass program. Must divide batch_size. Tune
+    # with `cli tune`: larger meta-batches at fixed HBM is how gemm+pad
+    # configs reach MXU saturation (ROADMAP item 2).
+    meta_accum_steps: int = 1
     # pool lowering: 'reshape' = tile-axes reshape + max, whose gradient is
     # an elementwise mask (~10x faster than select-and-scatter on CPU);
     # 'reduce_window' = XLA's native window reduce — on TPU the reshape
@@ -429,6 +441,33 @@ class MAMLConfig:
                 f"pad_channels must be 'auto', 'off', 'tile' or a positive "
                 f"int, got {self.pad_channels!r}"
             )
+        if not (
+            isinstance(self.meta_accum_steps, int)
+            and not isinstance(self.meta_accum_steps, bool)
+            and self.meta_accum_steps >= 1
+        ):
+            raise ValueError(
+                f"meta_accum_steps must be an int >= 1, got "
+                f"{self.meta_accum_steps!r}"
+            )
+        if self.batch_size % self.meta_accum_steps != 0:
+            raise ValueError(
+                f"meta_accum_steps={self.meta_accum_steps} must divide "
+                f"batch_size={self.batch_size}: the train step scans the "
+                "task axis in equal microbatches"
+            )
+        if self.meta_accum_steps > 1 and self.steps_per_dispatch > 8:
+            # the fused multi-step scan only unrolls at k <= 8 (compile
+            # time); a rolled outer scan compiles its body with
+            # width-dependent fusion, which would silently void the
+            # accumulation bit-exactness contract (core/maml.py,
+            # _meta_loss_and_grads) — refuse the combination loudly
+            raise ValueError(
+                f"meta_accum_steps={self.meta_accum_steps} requires "
+                f"steps_per_dispatch <= 8 (got {self.steps_per_dispatch}): "
+                "larger fused chunks keep a rolled outer scan whose "
+                "codegen breaks the accumulated-vs-monolithic equivalence"
+            )
         if self.pool_impl not in ("auto", "reshape", "reduce_window"):
             raise ValueError(
                 f"pool_impl must be 'auto', 'reshape' or 'reduce_window', "
@@ -607,21 +646,47 @@ class MAMLConfig:
         (few_shot_learning_system.py:332-335)."""
         return "imagenet" in self.dataset_name
 
+    def _tuned(self, knob: str):
+        """Measured value for ``knob`` from the device-kind-keyed tuning
+        table (``cli tune`` writes it — analysis/autotune.py), or None when
+        no table / no entry for this device kind + compute dtype exists.
+        Measured defaults beat heuristics: the PR-4 auto rules left
+        baseline-shaped TPU runs on the 'lax' conv path in practice
+        (BENCH_BASELINE recorded conv_impl='lax' at 2.5% MFU)."""
+        from .analysis import autotune
+
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 - no backend => no tuned entry
+            return None
+        entry = autotune.tuned_entry(device_kind, self.compute_dtype)
+        if entry is None:
+            return None
+        return entry.get(knob)
+
     @property
     def resolved_conv_impl(self) -> str:
-        """'auto' resolved against the live backend AND the task-axis mode.
+        """'auto' resolved through the tuning table first (a ``cli tune``
+        sweep measured the fastest lowering for this device kind + compute
+        dtype), then the backend/task-axis heuristic.
 
-        CPU: im2col (every AD order is a GEMM — sidesteps XLA:CPU's ~40x
-        kernel-gradient conv). Accelerators: when ``task_axis_mode='vmap'``
-        the inner loop carries per-task adapted weights, so every conv is a
-        batched-*weights* conv — the native lowering is a
-        ``feature_group_count=tasks`` grouped conv that XLA runs an order of
-        magnitude below MXU peak, while the 'gemm' lowering folds each layer
-        into one large batched GEMM; with ``task_axis_mode='map'`` weights
-        stay unbatched and the native conv is what the MXU tiles best.
+        Heuristic fallback — CPU: im2col (every AD order is a GEMM —
+        sidesteps XLA:CPU's ~40x kernel-gradient conv). Accelerators: when
+        ``task_axis_mode='vmap'`` the inner loop carries per-task adapted
+        weights, so every conv is a batched-*weights* conv — the native
+        lowering is a ``feature_group_count=tasks`` grouped conv that XLA
+        runs an order of magnitude below MXU peak, while the 'gemm'
+        lowering folds each layer into one large batched GEMM; with
+        ``task_axis_mode='map'`` weights stay unbatched and the native conv
+        is what the MXU tiles best.
         """
         if self.conv_impl != "auto":
             return self.conv_impl
+        tuned = self._tuned("conv_impl")
+        if tuned in ("lax", "im2col", "gemm"):
+            return tuned
         import jax
 
         if jax.default_backend() == "cpu":
@@ -630,12 +695,20 @@ class MAMLConfig:
 
     @property
     def resolved_pad_channels(self) -> Union[str, int]:
-        """'auto' resolved against the live backend: compute-only channel
-        padding pays off where the MXU tiles GEMM operands in (sublane,
-        128-lane) blocks; on CPU it is pure overhead, so 'auto' disables it.
-        Explicit 'off' / 'tile' / int values apply everywhere."""
+        """'auto' resolved through the tuning table first (see
+        ``resolved_conv_impl``), then the backend heuristic: compute-only
+        channel padding pays off where the MXU tiles GEMM operands in
+        (sublane, 128-lane) blocks; on CPU it is pure overhead, so 'auto'
+        disables it. Explicit 'off' / 'tile' / int values apply
+        everywhere."""
         if self.pad_channels != "auto":
             return self.pad_channels
+        tuned = self._tuned("pad_channels")
+        if tuned == "off" or tuned == "tile" or (
+            isinstance(tuned, int) and not isinstance(tuned, bool)
+            and tuned > 0
+        ):
+            return tuned
         import jax
 
         return "off" if jax.default_backend() == "cpu" else "tile"

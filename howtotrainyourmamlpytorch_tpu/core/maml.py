@@ -288,26 +288,120 @@ def _map_tasks(learner_call, mode, x_s, y_s, x_t, y_t):
     return jax.vmap(learner_call)(x_s, y_s, x_t, y_t)
 
 
+def _split_microbatches(accum: int, *batches):
+    """Reshape each batch's leading task axis b -> (accum, b // accum)."""
+    out = []
+    for a in batches:
+        b = a.shape[0]
+        if b % accum != 0:
+            raise ValueError(
+                f"meta_accum_steps={accum} must divide the task batch "
+                f"({b} tasks)"
+            )
+        out.append(a.reshape((accum, b // accum) + a.shape[1:]))
+    return tuple(out)
+
+
 def _meta_loss_and_grads(
-    learner, state, x_s, y_s, x_t, y_t, loss_weights, task_mode="vmap"
+    learner, state, x_s, y_s, x_t, y_t, loss_weights, task_mode="vmap",
+    accum=1,
 ):
-    """Outer loss + meta-gradients over the task batch."""
+    """Outer loss + meta-gradients over the task batch.
 
-    def outer_loss(trainable):
-        losses, (correct, bns, _, dyn) = _map_tasks(
-            lambda xs, ys, xt, yt: learner(
-                trainable["net"], trainable["lslr"], state.bn,
-                xs, ys, xt, yt, loss_weights,
-            ),
-            task_mode, x_s, y_s, x_t, y_t,
-        )
-        # mean over tasks (few_shot_learning_system.py:164)
-        return jnp.mean(losses), (correct, bns, dyn)
+    The meta-gradient is computed PER TASK (``value_and_grad`` of the
+    per-task loss, mapped over the task axis) and reduced once with an
+    explicit ``mean`` over the full task axis — mathematically identical
+    to differentiating the task-mean loss (the backward seeds distribute
+    over the mean), and the form that makes ``meta_accum_steps`` exact:
 
+    ``accum > 1`` scans the task axis in ``accum`` microbatches of
+    ``b / accum`` tasks inside the same program, stacking each
+    microbatch's per-task grads/losses/aux, then applies THE SAME final
+    reductions over the re-flattened (b, ...) stacks. Per-task values are
+    independent of the vmap width (each task's math is its own
+    GEMM/elementwise chain), so at matched total batch the accumulated
+    step is bit-exact (f32) with the monolithic one — while the
+    activation peak of differentiating through the inner loop shrinks
+    ~accum-fold (per-task grads are params-sized and cheap to stack; the
+    unrolled-inner-loop activations are what dominate HBM). Accumulation
+    stays in f32: per-task meta-grads are f32 (grads of the f32 master
+    params) on both the f32 and bf16 compute paths.
+
+    Three mechanisms make the exactness hold in practice (each measured
+    to drift by ~1 grad ulp without it):
+
+    * the ``optimization_barrier`` before the final reductions — without
+      it XLA fuses the cross-task mean into the monolithic backward,
+      reassociating the sum the scanned program materializes;
+    * the microbatch loop is FULLY UNROLLED (``lax.scan(..., unroll=
+      True)``) — a rolled loop body is compiled as its own computation
+      whose fusion choices differ from straight-line code, perturbing
+      per-task values themselves; unrolled, every microbatch lowers
+      exactly like the monolithic program (compile time grows ~linearly
+      with ``accum``, same discipline as the unrolled inner loop);
+    * each microbatch's inputs are gated on the previous microbatch's
+      losses through an ``optimization_barrier`` token — WITH the loop
+      unrolled the microbatches would otherwise be dataflow-independent
+      and XLA could schedule them concurrently, silently restoring the
+      monolithic activation peak; the token serializes them in dataflow
+      terms (statically visible: ``memory_analysis`` temp bytes drop
+      ~accum-fold, tested).
+
+    Cost of the barrier: one b x params-sized HBM round-trip per step —
+    noise next to the inner-loop activations. The caveats the tests pin:
+    the structural mechanisms above remove every GRAPH-level divergence,
+    but XLA's per-task codegen itself can still reassociate *within-task*
+    reductions when the vmap width crosses a hardware vectorization
+    boundary (measured on XLA:CPU/AVX-512: widths 2..12 agree bit-for-bit
+    at the test geometries, width 16 and width 1 drift by ~1 ulp) — keep
+    microbatch widths moderate (``2 <= b/accum``, and on CPU below the
+    16-lane boundary; the flagship's batch-12/accum-{2,4} sits squarely
+    in the verified envelope). bf16 compute remains ULP-bounded, not
+    bit-exact (the bf16 MXU passes reassociate internally).
+    """
     trainable = {"net": state.net, "lslr": state.lslr}
-    (loss, (correct, bns, dyn)), grads = jax.value_and_grad(
-        outer_loss, has_aux=True
-    )(trainable)
+
+    def per_task(xs, ys, xt, yt):
+        def task_loss(tr):
+            return learner(
+                tr["net"], tr["lslr"], state.bn, xs, ys, xt, yt,
+                loss_weights,
+            )
+
+        (loss, aux), task_grads = jax.value_and_grad(
+            task_loss, has_aux=True
+        )(trainable)
+        return loss, aux, task_grads
+
+    if accum > 1:
+        micro = _split_microbatches(accum, x_s, y_s, x_t, y_t)
+
+        def body(token, mb):
+            *mb_gated, token = jax.lax.optimization_barrier((*mb, token))
+            out = _map_tasks(per_task, task_mode, *mb_gated)
+            return out[0], out  # next token: this microbatch's losses
+
+        token0 = jnp.zeros((x_s.shape[0] // accum,), jnp.float32)
+        _, stacked = jax.lax.scan(body, token0, micro, unroll=True)
+        # flatten (accum, b/accum, ...) -> (b, ...): same per-task value
+        # stream as the monolithic program, reduced identically below
+        losses, (correct, bns, preds, dyn), grads = jax.tree_util.tree_map(
+            lambda v: v.reshape((-1,) + v.shape[2:]), stacked
+        )
+    else:
+        losses, (correct, bns, preds, dyn), grads = _map_tasks(
+            per_task, task_mode, x_s, y_s, x_t, y_t
+        )
+    del preds  # train never consumes the softmax stacks: stay DCE-able
+    # (deliberately OUTSIDE the barrier — a barrier would force XLA to
+    # compute them every step)
+    losses, correct, bns, dyn, grads = jax.lax.optimization_barrier(
+        (losses, correct, bns, dyn, grads)
+    )
+    # mean over tasks (few_shot_learning_system.py:164) — loss and grads
+    # reduce over the same full task axis in both branches
+    loss = jnp.mean(losses)
+    grads = jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
     return trainable, loss, correct, bns, grads, dyn
 
 
@@ -327,7 +421,7 @@ def make_grads_fn(cfg: MAMLConfig, second_order: bool):
     def grads_fn(state: MetaState, x_s, y_s, x_t, y_t, loss_weights):
         _, loss, _, _, grads, _ = _meta_loss_and_grads(
             learner, state, x_s, y_s, x_t, y_t, loss_weights,
-            cfg.task_axis_mode,
+            cfg.task_axis_mode, accum=cfg.meta_accum_steps,
         )
         return loss, grads
 
@@ -393,6 +487,14 @@ def make_train_step(
     them on device as a prelude; ``decode_uint8`` overrides the gate (the
     indexed path decodes inside its own expander).
 
+    ``cfg.meta_accum_steps > 1`` scans the meta-batch in that many
+    task microbatches INSIDE this one compiled step, accumulating the
+    per-task meta-grads in f32 and reducing them once — bit-exact (f32)
+    with the single-pass program at equal total batch while the
+    activation peak shrinks ~accum-fold (see ``_meta_loss_and_grads``).
+    All four train-step factories inherit it (the multi/indexed variants
+    wrap this step).
+
     ``telemetry_level='dynamics'`` adds a ``metrics['dynamics']`` dict to
     the output — per-inner-step support/target losses and per-layer
     inner-grad norms (task-mean, stacked ``(num_steps,)`` inside the
@@ -430,7 +532,7 @@ def make_train_step(
         opt = make_optimizer(cfg, state.net)
         trainable, loss, correct, bns, grads, dyn = _meta_loss_and_grads(
             learner, state, x_s, y_s, x_t, y_t, loss_weights,
-            cfg.task_axis_mode,
+            cfg.task_axis_mode, accum=cfg.meta_accum_steps,
         )
         raw_grads = grads  # pre-clip view for the health probes
         if cfg.clip_grads:
@@ -494,7 +596,16 @@ def make_train_multi_step(cfg: MAMLConfig, second_order: bool):
             st, metrics = step(st, xs, ys, xt, yt, loss_weights, lr)
             return st, metrics
 
-        return jax.lax.scan(body, state, (x_s, y_s, x_t, y_t))
+        # unroll small chunks (same policy + bound as the inner-loop
+        # scan): a rolled scan body is compiled as its own computation
+        # whose fusion choices differ from straight-line code, which
+        # would break the meta_accum_steps bit-exactness contract for
+        # the multi factories (see _meta_loss_and_grads) — and k fused
+        # updates are short (2-8) by construction
+        return jax.lax.scan(
+            body, state, (x_s, y_s, x_t, y_t),
+            unroll=True if x_s.shape[0] <= 8 else 1,
+        )
 
     return multi_step
 
@@ -597,6 +708,16 @@ def make_train_step_indexed(cfg: MAMLConfig, second_order: bool, augment: bool,
 
     def train_step(state: MetaState, store, gather, rot_k, loss_weights, lr):
         x_s, y_s, x_t, y_t = expand(store, gather, rot_k)
+        # materialize the expanded batch before the step: the plain
+        # factory's batches are program PARAMETERS; without this barrier
+        # the gather/decode/rot90 would fuse into the (microbatch-width)
+        # task bodies, whose codegen then depends on meta_accum_steps —
+        # breaking the accumulation bit-exactness contract for the
+        # indexed factories (one batch-sized materialization, the same
+        # bytes the expander produces anyway)
+        x_s, y_s, x_t, y_t = jax.lax.optimization_barrier(
+            (x_s, y_s, x_t, y_t)
+        )
         return step(state, x_s, y_s, x_t, y_t, loss_weights, lr)
 
     return train_step
@@ -617,7 +738,11 @@ def make_train_multi_step_indexed(
             st, metrics = step(st, store, g, r, loss_weights, lr)
             return st, metrics
 
-        return jax.lax.scan(body, state, (gather, rot_k))
+        # unrolled like make_train_multi_step (accum bit-exactness)
+        return jax.lax.scan(
+            body, state, (gather, rot_k),
+            unroll=True if gather.shape[0] <= 8 else 1,
+        )
 
     return multi_step
 

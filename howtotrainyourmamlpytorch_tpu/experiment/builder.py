@@ -207,6 +207,11 @@ class ExperimentBuilder:
         # per-step timing as first-class metrics (SURVEY.md §5 — the
         # reference only records epoch_run_time)
         self.step_timer = StepTimer()
+        # epoch-boundary overlap bookkeeping (ISSUE 11): the train-summary
+        # wall time spent under the in-flight eval tail, and that
+        # summary's result (run_validation_epoch computes it mid-overlap)
+        self._last_overlap_ms: Optional[float] = None
+        self._pre_summary_result: Optional[Dict[str, float]] = None
         self._active_pbar = None
         self._pbar_sums: Dict[str, tuple] = {}
         self._steps_this_run = 0
@@ -1253,7 +1258,18 @@ class ExperimentBuilder:
         losses, _ = self.model.run_validation_iters(list(val_samples))
         self._accumulate(losses, total_losses)
 
-    def run_validation_epoch(self) -> Dict[str, float]:
+    def run_validation_epoch(
+        self, pre_summary_fn=None
+    ) -> Dict[str, float]:
+        """The fused validation sweep. ``pre_summary_fn`` (the
+        epoch-boundary overlap, ISSUE 11): host work to run AFTER the last
+        fused eval dispatch is enqueued but BEFORE its metrics are synced
+        — the train loop passes its epoch-summary reduction here, so the
+        device->host fetch of the epoch's train metrics overlaps the
+        in-flight eval tail instead of serializing behind it. The wall
+        time that work took under an in-flight dispatch is recorded as
+        ``overlap_ms`` (per-epoch ``dispatch`` telemetry, schema v7); its
+        return value is picked up from ``self._pre_summary_result``."""
         total_losses: Dict[str, List[float]] = {}
         pbar_sums: Dict[str, tuple] = {}
         n_batches = int(self.cfg.num_evaluation_tasks / self.cfg.batch_size)
@@ -1283,6 +1299,14 @@ class ExperimentBuilder:
         finally:
             if pbar is not None:
                 pbar.close()
+        self._pre_summary_result = None
+        if pre_summary_fn is not None:
+            # the last eval chunk is still in flight (the system's
+            # one-step-lag never blocks on the dispatch it just enqueued)
+            t0 = time.perf_counter()
+            self._pre_summary_result = pre_summary_fn()
+            self._last_overlap_ms = (time.perf_counter() - t0) * 1e3
+        # the one synchronization point: reduce the val metric stacks
         return self.build_summary_dict(total_losses, "val")
 
     def _stream_metrics(self) -> Dict[str, float]:
@@ -1375,8 +1399,21 @@ class ExperimentBuilder:
         self.telemetry.epoch_scalars(self.epoch, epoch_summary)
         if self.telemetry.enabled:
             if timing:
+                # schema v7: the dispatch record carries the epoch-boundary
+                # overlap (ms of train-summary host work that ran under the
+                # in-flight eval tail + how many phase-transition lag
+                # blocks the system skipped) and the step's accumulation
+                # setting, so `cli inspect summary` can print utilization
+                # without the run's stdout
+                overlap = self.model.pop_overlap_stats()
                 self.telemetry.event(
-                    "dispatch", epoch=int(self.epoch), **timing
+                    "dispatch", epoch=int(self.epoch), **timing,
+                    overlap_ms=(
+                        round(self._last_overlap_ms, 3)
+                        if self._last_overlap_ms is not None else None
+                    ),
+                    boundary_overlaps=int(overlap["boundary_overlaps"]),
+                    accum_steps=int(self.cfg.meta_accum_steps),
                 )
             self.telemetry.event(
                 "device_memory",
@@ -1506,10 +1543,17 @@ class ExperimentBuilder:
 
                 if self.state["current_iter"] % cfg.total_iter_per_epoch == 0:
                     self._close_pbar()
-                    train_losses = self.build_summary_dict(
-                        self.total_losses, "train"
+                    # double-buffered epoch boundary: the fused eval
+                    # dispatches are enqueued FIRST, then the train-side
+                    # epoch summary (a device->host reduction over the
+                    # whole epoch's buffered metrics) runs while the eval
+                    # tail is still executing — see run_validation_epoch
+                    val_losses = self.run_validation_epoch(
+                        pre_summary_fn=lambda: self.build_summary_dict(
+                            self.total_losses, "train"
+                        )
                     )
-                    val_losses = self.run_validation_epoch()
+                    train_losses = self._pre_summary_result
                     if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
                         self._log(
                             f"Best validation accuracy "

@@ -182,6 +182,21 @@ class MAMLFewShotClassifier:
         # step (backpressure against queued-input OOM) while still
         # overlapping host work with device compute
         self._pending_sync = None
+        # dispatch-overlap at phase transitions (single-host): the lag
+        # block exists as input backpressure WITHIN a phase; at a
+        # train->eval or eval->train boundary the next program's inputs
+        # are already staged and the device stream orders execution, so
+        # the host skips the block — the fused eval dispatch is enqueued
+        # while the epoch's last train dispatch still runs, and the next
+        # epoch's first train dispatch while the eval tail still runs
+        # (over a networked device transport each skipped block is one
+        # ~0.5s host round-trip off the epoch boundary). Run-ahead stays
+        # bounded: the next SAME-phase dispatch blocks on this one's sync
+        # handle as usual. Multihost keeps strict one-at-a-time ordering
+        # (its collectives must never overlap — see _serialize_dispatches).
+        self._pending_phase: Optional[str] = None
+        self._overlap_boundary = not self.multihost
+        self._boundary_overlaps = 0
         # runtime retrace detector (analysis/auditor.py), installed by the
         # experiment builder when cfg.analysis_level != 'off'; None keeps
         # every dispatch at a single attribute check (same off-path
@@ -227,6 +242,30 @@ class MAMLFewShotClassifier:
         enqueued. On real pods the extra wait is the tail of the metric
         psums — negligible next to the step itself."""
         return metrics if self.multihost else metrics["loss"]
+
+    def _sync_before_dispatch(self, phase: str) -> None:
+        """The one-step-lag block, phase-aware: wait for the previous
+        dispatch before enqueuing the next — EXCEPT at a single-host phase
+        transition (train<->eval), where the block is skipped and two
+        dispatches overlap in flight (see the contract note on
+        ``_overlap_boundary`` in ``__init__``)."""
+        if self._pending_sync is not None:
+            if (
+                self._overlap_boundary
+                and self._pending_phase is not None
+                and self._pending_phase != phase
+            ):
+                self._boundary_overlaps += 1
+            else:
+                jax.block_until_ready(self._pending_sync)
+        self._pending_phase = phase
+
+    def pop_overlap_stats(self) -> Dict[str, int]:
+        """Boundary-overlap counters since the last pop (the builder's
+        per-epoch ``dispatch`` telemetry record carries them)."""
+        out = {"boundary_overlaps": self._boundary_overlaps}
+        self._boundary_overlaps = 0
+        return out
 
     def _maybe_serialize(self, *trees) -> None:
         """CPU-multihost only (see ``_serialize_dispatches``): force every
@@ -410,11 +449,14 @@ class MAMLFewShotClassifier:
             return mesh_lib.shard_stacked_batch(self.mesh, gather, rot_k)
         return jax.device_put((gather, rot_k))
 
-    def _stage_indexed(self, batch_or_batches, stacked: bool):
+    def _stage_indexed(self, batch_or_batches, stacked: bool,
+                       phase: str = "train"):
         """The shared prelude of every indexed dispatch: enqueue the (tiny)
         index upload and resolve the resident store FIRST, then apply the
-        one-step-lag sync — same H2D-overlaps-in-flight-dispatch ordering as
-        the pixel paths. Returns (store, (gather, rot_k), augment)."""
+        phase-aware one-step-lag sync — the index H2D is always in flight
+        before the pending-dispatch block fires, so the upload overlaps the
+        still-running previous dispatch (same ordering as the pixel
+        paths). Returns (store, (gather, rot_k), augment)."""
         if stacked:
             placed = self._upload_stacked_indices(batch_or_batches)
             first = batch_or_batches[0]
@@ -422,8 +464,7 @@ class MAMLFewShotClassifier:
             placed = self._prepare_index_batch(batch_or_batches)
             first = batch_or_batches
         store = self._device_store(first.set_name)
-        if self._pending_sync is not None:
-            jax.block_until_ready(self._pending_sync)
+        self._sync_before_dispatch(phase)
         return store, placed, first.augment
 
     def _convert_batch(self, data_batch):
@@ -550,8 +591,7 @@ class MAMLFewShotClassifier:
         # pipeline. (Zero sync would let the host run an epoch ahead, pinning
         # every queued input batch in device memory; per-step float() would
         # serialize host and device completely.)
-        if self._pending_sync is not None:
-            jax.block_until_ready(self._pending_sync)
+        self._sync_before_dispatch("train")
         if self.retrace_detector is not None:
             self._observe_dispatch(
                 f"train_step[so={int(second_order)}]",
@@ -629,8 +669,7 @@ class MAMLFewShotClassifier:
         stacked = self._upload_stacked(prepared)
         # upload already enqueued above — blocking here only bounds run-ahead
         # to one in-flight dispatch while this chunk's H2D streams in
-        if self._pending_sync is not None:
-            jax.block_until_ready(self._pending_sync)
+        self._sync_before_dispatch("train")
         if self.retrace_detector is not None:
             self._observe_dispatch(
                 f"train_multi_step[so={int(second_order)},k={k}]",
@@ -658,7 +697,7 @@ class MAMLFewShotClassifier:
         """
         if isinstance(data_batch, IndexBatch):
             store, (gather, rot_k), augment = self._stage_indexed(
-                data_batch, stacked=False
+                data_batch, stacked=False, phase="eval"
             )
             if self.retrace_detector is not None:
                 self._observe_dispatch(
@@ -671,8 +710,8 @@ class MAMLFewShotClassifier:
             )
         else:
             x_s, y_s, x_t, y_t = self._prepare_batch(data_batch)
-            if self._pending_sync is not None:  # same one-step pipeline as train
-                jax.block_until_ready(self._pending_sync)
+            # same one-step pipeline as train; phase-aware at the boundary
+            self._sync_before_dispatch("eval")
             if self.retrace_detector is not None:
                 self._observe_dispatch(
                     "eval_step", (self.state, x_s, y_s, x_t, y_t)
@@ -730,7 +769,7 @@ class MAMLFewShotClassifier:
             return losses, preds
         if isinstance(data_batches[0], IndexBatch):
             store, placed, augment = self._stage_indexed(
-                data_batches, stacked=True
+                data_batches, stacked=True, phase="eval"
             )
             if self.retrace_detector is not None:
                 self._observe_dispatch(
@@ -745,8 +784,8 @@ class MAMLFewShotClassifier:
         else:
             prepared = [self._convert_batch(b) for b in data_batches]
             stacked = self._upload_stacked(prepared)
-            if self._pending_sync is not None:  # same one-step pipeline as train
-                jax.block_until_ready(self._pending_sync)
+            # same one-step pipeline as train; phase-aware at the boundary
+            self._sync_before_dispatch("eval")
             if self.retrace_detector is not None:
                 self._observe_dispatch(
                     f"eval_multi_step[preds={int(return_preds)},"

@@ -25,6 +25,7 @@ from jax.ad_checkpoint import checkpoint_name
 # Dimension numbers for NHWC activations with HWIO kernels.
 CONV_DIMS = ("NHWC", "HWIO", "NHWC")
 
+
 # MXU tiling: the lane (minor-most) dimension of every on-chip tile is 128;
 # the sublane tile depends on dtype (f32 (8, 128), bf16 (16, 128)).
 MXU_LANES = 128
@@ -153,6 +154,24 @@ def conv2d(
     (and therefore before any norm layer), so results are bit-exact with the
     unpadded op while every GEMM dimension is lane/sublane aligned.
     """
+    out = _conv2d_raw(x, w, b, stride, padding, impl, pad_channels)
+    # named for remat_policy='save_conv' (save_only_these_names); a no-op
+    # unless a checkpoint policy references the name
+    return checkpoint_name(out, "conv_out")
+
+
+def _conv2d_raw(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    b: Optional[jnp.ndarray],
+    stride: int,
+    padding: int,
+    impl: str,
+    pad_channels: Union[str, int],
+) -> jnp.ndarray:
+    """``conv2d`` without the remat checkpoint name — the building block
+    ``conv_bn_act`` composes so the save point can sit AFTER the fused
+    epilogue instead of between conv and norm."""
     kh, kw, cin, cout = w.shape
     cin_p = pad_target(cin, pad_channels, x.dtype)
     cout_p = pad_target(cout, pad_channels, x.dtype)
@@ -184,9 +203,47 @@ def conv2d(
         out = out[..., :cout]
     if b is not None:
         out = out + b.astype(out.dtype)
-    # named for remat_policy='save_conv' (save_only_these_names); a no-op
-    # unless a checkpoint policy references the name
-    return checkpoint_name(out, "conv_out")
+    return out
+
+
+def conv_bn_act(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    b: Optional[jnp.ndarray],
+    gamma: jnp.ndarray,
+    beta: jnp.ndarray,
+    running_mean: Optional[jnp.ndarray],
+    running_var: Optional[jnp.ndarray],
+    stride: int,
+    padding: int,
+    impl: str = "lax",
+    pad_channels: Union[str, int] = "off",
+    negative_slope: float = 0.01,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """The reference's used block (``MetaConvNormLayerReLU``) as ONE op:
+    conv -> bias -> batch-norm (batch statistics + running-stat update) ->
+    leaky-relu, returning ``(activation, new_running_mean, new_running_var)``.
+
+    Exactly the composition ``conv2d`` + ``batch_norm`` + ``leaky_relu``
+    compute — same primitives in the same order, so it is bit-identical
+    to the unfused calls (the conv-impl/pad-channels equivalence tests
+    gate it). What moves is the remat save point: ``conv2d`` names its
+    output ``conv_out`` BETWEEN conv and norm, so under
+    ``remat_policy='save_conv'`` the backward re-runs the whole per-layer
+    elementwise tail (bias, BN stats + normalize + affine, leaky-relu) —
+    the top non-GEMM contributors in the PR 8 roofline decomposition.
+    Here the name marks the POST-activation tensor: the GEMM and its
+    entire elementwise epilogue become one saved fusion region, and the
+    backward recomputes none of it. (``remat_policy='full'`` and the
+    no-remat path are indifferent to the name — checkpoint_name is a
+    no-op unless a policy references it.)
+    """
+    out = _conv2d_raw(x, w, b, stride, padding, impl, pad_channels)
+    out, new_mean, new_var = batch_norm(
+        out, gamma, beta, running_mean, running_var
+    )
+    out = jax.nn.leaky_relu(out, negative_slope=negative_slope)
+    return checkpoint_name(out, "conv_out"), new_mean, new_var
 
 
 def linear(

@@ -13,7 +13,15 @@ Record kinds:
 * ``run_start`` / ``run_end`` — run lifecycle markers;
 * ``epoch``          — the per-epoch scalar summary (the CSV row's twin);
 * ``stream``         — loader producer stats (assembly/stall/queue depth);
-* ``dispatch``       — per-epoch dispatch-timing stats (StepTimer summary);
+* ``dispatch``       — per-epoch dispatch-timing stats (StepTimer
+  summary; since v7 also the epoch-boundary overlap: ``overlap_ms`` =
+  host milliseconds of train-summary work that ran under the in-flight
+  fused eval tail, ``boundary_overlaps`` = phase-transition lag blocks
+  the dispatch pipeline skipped, ``accum_steps`` = the step's
+  ``meta_accum_steps`` setting. With two dispatches legally in flight at
+  the boundary, per-dispatch timings at the boundary measure ENQUEUE-to-
+  ENQUEUE latency, not device occupancy — the overlap fields say how much
+  of the boundary was hidden);
 * ``checkpoint``     — a checkpoint write (epoch index + path);
 * ``device_memory``  — HBM stats vs. the store registry's expectation;
 * ``dynamics``       — on-device training dynamics for one fused dispatch
@@ -110,6 +118,15 @@ Version history / migration notes:
   unchanged (``tests/fixtures/telemetry_v5_schema.jsonl`` pins a v5-era
   log) and the forward-compat rules carry over (the future-schema
   fixture is re-pinned at v7-unknown).
+* **v7** — the ``dispatch`` record gains the optional epoch-boundary
+  overlap fields (``overlap_ms`` / ``boundary_overlaps`` /
+  ``accum_steps`` — the throughput-overhaul telemetry: how much of the
+  epoch boundary the double-buffered dispatch pipeline hid, and the
+  train step's gradient-accumulation setting). Pure addition — no new
+  kinds, no new REQUIRED fields: every v1..v6 record validates unchanged
+  (``tests/fixtures/telemetry_v6_schema.jsonl`` pins a v6-era log) and
+  the forward-compat rules carry over (the future-schema fixture is
+  re-pinned at v8-unknown).
 """
 
 from __future__ import annotations
@@ -117,7 +134,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 

@@ -151,6 +151,45 @@ def _dispatch_stats(records: List[dict]) -> Optional[Dict[str, float]]:
     )) or None
 
 
+def _overlap_stats(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Epoch-boundary overlap utilization from the ``dispatch`` records
+    (schema v7): mean/total overlapped milliseconds (train-summary host
+    work hidden under the in-flight fused eval tail), total skipped
+    phase-transition lag blocks, and the run's accumulation setting (the
+    last record wins — it is a config constant within a run). None when
+    no dispatch record carries the v7 fields (an older log)."""
+    disp = [r for r in records if r.get("kind") == "dispatch"]
+    overlaps = [
+        r["overlap_ms"] for r in disp
+        if isinstance(r.get("overlap_ms"), (int, float))
+        and not isinstance(r.get("overlap_ms"), bool)
+        and math.isfinite(r["overlap_ms"])
+    ]
+    boundary = [
+        r["boundary_overlaps"] for r in disp
+        if isinstance(r.get("boundary_overlaps"), int)
+        and not isinstance(r.get("boundary_overlaps"), bool)
+    ]
+    accum = next(
+        (
+            r["accum_steps"] for r in reversed(disp)
+            if isinstance(r.get("accum_steps"), int)
+            and not isinstance(r.get("accum_steps"), bool)
+        ),
+        None,
+    )
+    if not overlaps and not boundary and accum is None:
+        return None
+    return {
+        "overlap_ms_mean": (
+            sum(overlaps) / len(overlaps) if overlaps else None
+        ),
+        "overlap_ms_total": sum(overlaps) if overlaps else None,
+        "boundary_overlaps_total": sum(boundary) if boundary else 0,
+        "accum_steps": accum,
+    }
+
+
 def _stream_stats(records: List[dict]) -> Optional[Dict[str, float]]:
     return _mean_of(records, "stream", (
         "assembly_ms_per_batch", "stall_ms_per_batch", "queue_depth_mean",
@@ -201,6 +240,8 @@ def cmd_summary(args) -> int:
         "best_val_accuracy": best[1] if best else None,
         "best_val_epoch": best[0] if best else None,
         "dispatch_timing": _dispatch_stats(records),
+        # epoch-boundary overlap utilization (schema v7 dispatch fields)
+        "overlap": _overlap_stats(records),
         "stream": _stream_stats(records),
         "device_memory": _memory_stats(records),
         "anomalies": counts.get("anomaly", 0),
@@ -263,6 +304,21 @@ def cmd_summary(args) -> int:
             if key in disp:
                 parts.append(f"{q} {disp[key]:.1f}ms")
         lines.append("  dispatch: " + ", ".join(parts))
+    ov = payload["overlap"]
+    if ov:
+        parts = []
+        if ov.get("overlap_ms_mean") is not None:
+            parts.append(
+                f"boundary overlap {ov['overlap_ms_mean']:.1f}ms/epoch "
+                f"({ov['overlap_ms_total']:.1f}ms total hidden)"
+            )
+        parts.append(
+            f"{ov.get('boundary_overlaps_total', 0)} phase-transition "
+            "block(s) skipped"
+        )
+        if ov.get("accum_steps") is not None:
+            parts.append(f"accum_steps={ov['accum_steps']}")
+        lines.append("  overlap: " + ", ".join(parts))
     stream = payload["stream"]
     if stream:
         lines.append(

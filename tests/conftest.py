@@ -15,6 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+os.environ.setdefault(
+    # hermeticity: a TUNING.json a developer measured at the repo root
+    # must not flip `auto` lowering resolution under the test suite (the
+    # autotune tests point this at their own tmp tables explicitly)
+    "MAML_TUNING_TABLE",
+    os.path.join(os.path.dirname(__file__), "_no_tuning_table.json"),
+)
+
 import jax
 
 # The sandbox's sitecustomize registers an experimental TPU-tunnel backend

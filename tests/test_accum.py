@@ -1,0 +1,393 @@
+"""``meta_accum_steps`` — task-microbatched meta-gradient accumulation.
+
+The contract (ISSUE 11): the accumulated train step scans the meta-batch
+in microbatches INSIDE one compiled dispatch, accumulating per-task
+meta-grads in f32, and is **bit-exact** (f32) with the monolithic step at
+equal total batch — for every train-step factory — while donation stays
+whole-state and the dispatch signature stays retrace-free across accum
+settings. bf16 compute is ULP-bounded, not bit-exact (the MXU's bf16
+passes reassociate internally).
+
+Exactness holds for microbatches of >= 2 tasks (config batch 8, accum
+{1, 2, 4} here): a width-1 batched GEMM lowers as a plain GEMM whose
+blocking can reassociate *within-task* partial sums — the documented
+caveat in ``core.maml._meta_loss_and_grads``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import make_micro_cfg, make_synthetic_batch
+
+from howtotrainyourmamlpytorch_tpu.core import maml, msl
+
+BATCH = 8  # power of two, microbatch width >= 2 for accum in {1, 2, 4}
+ACCUMS = (1, 2, 4)
+
+
+def _cfg(**overrides):
+    return make_micro_cfg(batch_size=BATCH, **overrides)
+
+
+def _weights(cfg):
+    return jnp.asarray(
+        msl.loss_weights_for(
+            cfg.number_of_training_steps_per_iter,
+            cfg.use_multi_step_loss_optimization,
+            True,
+            0,
+            cfg.multi_step_loss_num_epochs,
+        )
+    )
+
+
+def _index_batch(cfg, store_images=64, seed=0):
+    """A synthetic resident uint8 store + one valid index batch."""
+    rng = np.random.RandomState(seed)
+    h, w, c = cfg.im_shape
+    store = rng.randint(0, 255, (store_images, h, w, c), dtype=np.uint8)
+    per = cfg.num_samples_per_class + cfg.num_target_samples
+    gather = rng.randint(
+        0, store_images,
+        (cfg.batch_size, cfg.num_classes_per_set, per), dtype=np.int64,
+    ).astype(np.int32)
+    rot_k = np.zeros(
+        (cfg.batch_size, cfg.num_classes_per_set), dtype=np.int32
+    )
+    return store, gather, rot_k
+
+
+def _assert_tree_bitexact(a, b, context=""):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, context
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.tobytes() == y.tobytes(), (
+            f"{context}: max abs diff "
+            f"{np.max(np.abs(x.astype(np.float64) - y.astype(np.float64)))}"
+        )
+
+
+def test_accum_divisibility_validated():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+    with pytest.raises(ValueError, match="meta_accum_steps"):
+        make_micro_cfg(batch_size=4, meta_accum_steps=3)
+    with pytest.raises(ValueError, match="meta_accum_steps"):
+        make_micro_cfg(meta_accum_steps=0)
+    with pytest.raises(ValueError, match="meta_accum_steps"):
+        MAMLConfig(dataset_name="omniglot_dataset", meta_accum_steps="two")
+    cfg = make_micro_cfg(batch_size=4, meta_accum_steps=4)
+    assert cfg.meta_accum_steps == 4
+    # accum>1 with a fused chunk too large to unroll would silently void
+    # the bit-exactness contract (rolled outer scan) — refused at config
+    # time; accum=1 keeps any chunk size
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        make_micro_cfg(
+            batch_size=4, meta_accum_steps=2, steps_per_dispatch=10
+        )
+    assert make_micro_cfg(
+        batch_size=4, meta_accum_steps=2, steps_per_dispatch=8
+    ).steps_per_dispatch == 8
+    assert make_micro_cfg(steps_per_dispatch=10).steps_per_dispatch == 10
+
+
+def test_accum_trace_time_batch_mismatch_is_loud():
+    """A step traced for accum=2 refuses a batch the setting cannot
+    split, instead of silently computing something else."""
+    cfg = _cfg(meta_accum_steps=2)
+    state = maml.init_state(cfg)
+    # a 3-task batch from a plain config (replace() on the accum config
+    # would already fail the config-time divisibility validation)
+    x_s, y_s, x_t, y_t = make_synthetic_batch(make_micro_cfg(batch_size=3))
+    with pytest.raises(ValueError, match="must divide"):
+        jax.jit(maml.make_train_step(cfg, second_order=True))(
+            state, x_s, y_s, x_t, y_t, _weights(cfg), 0.01
+        )
+
+
+def test_accum_bit_exact_f32_plain_step():
+    """The tier-1 fast-lane equivalence: accum in {1, 2, 4} produce
+    bit-identical f32 metrics AND post-update state through the full
+    second-order train step at equal total batch."""
+    base = _cfg()
+    x_s, y_s, x_t, y_t = make_synthetic_batch(base)
+    w = _weights(base)
+    results = {}
+    for accum in ACCUMS:
+        cfg = base.replace(meta_accum_steps=accum)
+        step = jax.jit(maml.make_train_step(cfg, second_order=True))
+        state = maml.init_state(cfg)  # deterministic from cfg.seed
+        new_state, metrics = step(state, x_s, y_s, x_t, y_t, w, 0.01)
+        results[accum] = (jax.device_get(new_state), jax.device_get(metrics))
+    ref_state, ref_metrics = results[1]
+    for accum in ACCUMS[1:]:
+        st, m = results[accum]
+        _assert_tree_bitexact(
+            m["loss"], ref_metrics["loss"], f"loss accum={accum}"
+        )
+        _assert_tree_bitexact(
+            m["accuracy"], ref_metrics["accuracy"], f"accuracy accum={accum}"
+        )
+        for part in ("net", "lslr", "bn"):
+            _assert_tree_bitexact(
+                getattr(st, part), getattr(ref_state, part),
+                f"state.{part} accum={accum}",
+            )
+
+
+def _assert_family_bitexact(run_family):
+    ref = run_family(1)
+    for accum in (2, 4):
+        got = run_family(accum)
+        for name in ref:
+            ref_state, ref_metrics = ref[name]
+            st, m = got[name]
+            _assert_tree_bitexact(
+                m["loss"], ref_metrics["loss"], f"{name} loss accum={accum}"
+            )
+            for part in ("net", "lslr", "bn"):
+                _assert_tree_bitexact(
+                    getattr(st, part), getattr(ref_state, part),
+                    f"{name} state.{part} accum={accum}",
+                )
+
+
+@pytest.mark.slow
+def test_accum_bit_exact_f32_pixel_factories():
+    """The acceptance matrix, pixel half: plain + multi (fused
+    steps_per_dispatch) factories stay bit-exact (f32) across accum
+    {1, 2, 4} at equal total batch."""
+    base = _cfg()
+    x_s, y_s, x_t, y_t = make_synthetic_batch(base)
+    w = _weights(base)
+    k = 2
+    stacked = tuple(
+        np.stack([a] * k) for a in (x_s, y_s, x_t, y_t)
+    )
+
+    def run_family(accum):
+        cfg = base.replace(meta_accum_steps=accum)
+        out = {}
+        state = maml.init_state(cfg)
+        out["plain"] = jax.jit(maml.make_train_step(cfg, True))(
+            state, x_s, y_s, x_t, y_t, w, 0.01
+        )
+        state = maml.init_state(cfg)
+        out["multi"] = jax.jit(maml.make_train_multi_step(cfg, True))(
+            state, *stacked, w, 0.01
+        )
+        return jax.device_get(out)
+
+    _assert_family_bitexact(run_family)
+
+
+@pytest.mark.slow
+def test_accum_bit_exact_f32_indexed_factories():
+    """The acceptance matrix, device-resident half, at batch 12 — the
+    flagship's measured per-chip HBM-ceiling batch (microbatch widths
+    12/6/3, inside the verified width envelope).
+
+    ``indexed``: bit-exact (f32) across accum {1, 2, 4} — the
+    single-update accumulated-vs-monolithic contract, same bar as the
+    pixel factories. ``multi_indexed`` (k chained fused updates): its
+    FIRST update — the one consuming entry-parameter state, where the
+    accumulation contract is well-posed — is bit-exact via its metrics;
+    the full chain is tolerance-bounded: updates past the first consume
+    intermediate state, whose within-task codegen XLA may reassociate at
+    ~1 ulp independent of accumulation (the same effect that makes fused
+    multi-step vs k sequential dispatches tolerance-equal, not bitwise —
+    test_system.py::test_run_train_iters_matches_sequential), and Adam
+    amplifies that on ~zero-gradient params."""
+    base = make_micro_cfg(batch_size=12)
+    w = _weights(base)
+    k = 2
+    store, gather, rot_k = _index_batch(base)
+    gather_k = np.stack([gather] * k)
+    rot_k_k = np.stack([rot_k] * k)
+
+    def run_indexed(accum):
+        cfg = base.replace(meta_accum_steps=accum)
+        out = {}
+        state = maml.init_state(cfg)
+        out["indexed"] = jax.jit(
+            maml.make_train_step_indexed(cfg, True, augment=False)
+        )(state, store, gather, rot_k, w, 0.01)
+        return jax.device_get(out)
+
+    _assert_family_bitexact(run_indexed)
+
+    multi = {}
+    for accum in (1, 2, 4):
+        cfg = base.replace(meta_accum_steps=accum)
+        state = maml.init_state(cfg)
+        st, m = jax.jit(
+            maml.make_train_multi_step_indexed(cfg, True, augment=False)
+        )(state, store, gather_k, rot_k_k, w, 0.01)
+        multi[accum] = (jax.device_get(st), jax.device_get(m))
+    ref_state, ref_m = multi[1]
+    for accum in (2, 4):
+        st, m = multi[accum]
+        # update 1 (entry state): the accumulation contract, bit-exact
+        _assert_tree_bitexact(
+            np.asarray(m["loss"])[0], np.asarray(ref_m["loss"])[0],
+            f"multi_indexed first-update loss accum={accum}",
+        )
+        # the chained tail: tolerance-bounded (see docstring)
+        np.testing.assert_allclose(
+            np.asarray(m["loss"]), np.asarray(ref_m["loss"]),
+            rtol=1e-5, err_msg=f"multi_indexed losses accum={accum}",
+        )
+        for part in ("net", "lslr"):
+            for key in getattr(ref_state, part):
+                np.testing.assert_allclose(
+                    np.asarray(getattr(st, part)[key]),
+                    np.asarray(getattr(ref_state, part)[key]),
+                    atol=2e-3,
+                    err_msg=f"multi_indexed {part}.{key} accum={accum}",
+                )
+
+
+def test_accum_bf16_ulp_bounded():
+    """bf16 compute: accumulated vs monolithic stays within a few bf16
+    ULPs (the f32 master params absorb most of it — the bound here is
+    loose only relative to f32's exact-equality bar)."""
+    base = _cfg(compute_dtype="bfloat16")
+    x_s, y_s, x_t, y_t = make_synthetic_batch(base)
+    w = _weights(base)
+    outs = {}
+    for accum in (1, 2):
+        cfg = base.replace(meta_accum_steps=accum)
+        step = jax.jit(maml.make_train_step(cfg, second_order=True))
+        state = maml.init_state(cfg)
+        new_state, metrics = step(state, x_s, y_s, x_t, y_t, w, 0.01)
+        outs[accum] = (jax.device_get(new_state), jax.device_get(metrics))
+    (s1, m1), (s2, m2) = outs[1], outs[2]
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=3e-2)
+    for part in ("net", "lslr"):
+        for key in getattr(s1, part):
+            np.testing.assert_allclose(
+                np.asarray(getattr(s1, part)[key], np.float32),
+                np.asarray(getattr(s2, part)[key], np.float32),
+                rtol=3e-2, atol=3e-2, err_msg=f"{part}.{key}",
+            )
+
+
+def test_accum_step_donates_whole_state():
+    """Donation survives accumulation: the accumulated step's executable
+    aliases at least the whole MetaState (the TRAIN_DONATE audit passes —
+    same contract the un-accumulated family pins in test_donation)."""
+    from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
+
+    cfg = _cfg(meta_accum_steps=2)
+    auditor = audit_lib.ProgramAuditor(cfg)
+    state = audit_lib._state_avals(cfg)
+    weights = jax.ShapeDtypeStruct(
+        (cfg.number_of_training_steps_per_iter,), jnp.float32
+    )
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    batch = audit_lib._batch_avals(cfg)
+    report = auditor.audit(
+        "train_step[so=1,accum=2]",
+        jax.jit(maml.make_train_step(cfg, True),
+                donate_argnums=maml.TRAIN_DONATE),
+        (state, *batch, weights, lr),
+        donate=maml.TRAIN_DONATE,
+    )
+    donation_violations = [
+        v for v in report.violations if v.contract == "donation"
+    ]
+    assert donation_violations == []
+    assert report.donation is not None
+    assert report.donation["alias_size_bytes"] >= audit_lib.tree_byte_size(
+        state
+    )
+
+
+def test_accum_dispatches_are_retrace_free(tmp_path):
+    """Accumulation is a STATIC trace knob: repeated dispatches through
+    the system facade at any accum setting keep one abstract signature
+    per site (the PR 7 RetraceDetector observes zero retraces)."""
+    from howtotrainyourmamlpytorch_tpu.analysis.auditor import RetraceDetector
+    from howtotrainyourmamlpytorch_tpu.experiment.system import (
+        MAMLFewShotClassifier,
+    )
+
+    cfg = _cfg(meta_accum_steps=2)
+    model = MAMLFewShotClassifier(cfg, use_mesh=False)
+    detector = RetraceDetector(strict=True)
+    model.retrace_detector = detector
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg)
+    batch = (x_s, x_t, y_s, y_t)  # facade convention
+    for _ in range(3):
+        model.run_train_iter(batch, epoch=0)
+    metrics, _ = model.run_validation_iter(batch)
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
+    assert detector.retrace_count == 0
+
+
+@pytest.mark.slow
+def test_accum_serializes_microbatches_and_never_grows_temps():
+    """The memory half of the contract, stated at the strength the
+    backend guarantees it: the accumulated program carries the
+    microbatch serialization chain (one input-gating optimization
+    barrier per microbatch, plus the final reduction barrier — so a
+    memory-aware scheduler CAN run one microbatch's activations at a
+    time instead of the monolithic live set), and the static temp
+    allocation never grows vs the monolithic step. The realized peak is
+    the scheduler's call per backend: XLA:CPU keeps backwards coalesced
+    (temps shrink only slightly here), the TPU memory-aware scheduler is
+    what the HBM decoupling targets — on-device numbers belong to the
+    BENCH trajectory, not this CPU test."""
+    base = make_micro_cfg(
+        batch_size=8, image_height=28, image_width=28, cnn_num_filters=16,
+        num_stages=3, num_target_samples=8, use_remat=False,
+    )
+    temps = {}
+    barriers = {}
+    for accum in (1, 4):
+        cfg = base.replace(meta_accum_steps=accum)
+        step = jax.jit(
+            maml.make_train_step(cfg, True),
+            donate_argnums=maml.TRAIN_DONATE,
+        )
+        state = jax.eval_shape(lambda cfg=cfg: maml.init_state(cfg))
+        x_s, y_s, x_t, y_t = make_synthetic_batch(base)
+        args = [
+            jax.ShapeDtypeStruct(a.shape, a.dtype)
+            for a in (x_s, y_s, x_t, y_t)
+        ]
+        w = jax.ShapeDtypeStruct(
+            (cfg.number_of_training_steps_per_iter,), jnp.float32
+        )
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        traced = step.trace(state, *args, w, lr)
+        # the serialization chain is a trace-level structure (XLA:CPU
+        # folds barriers out of the optimized HLO text): the accumulated
+        # program adds the input gate inside the scanned microbatch body
+        # (the jaxpr shows the scan body once — unrolling happens at
+        # lowering) on top of the shared pre-reduction barrier
+        barriers[accum] = str(traced.jaxpr).count("optimization_barrier")
+        compiled = traced.lower().compile()
+        temps[accum] = int(compiled.memory_analysis().temp_size_in_bytes)
+    assert barriers[1] == 1, barriers
+    assert barriers[4] == 2, barriers
+    # and accumulation never INCREASES the static allocation
+    assert temps[4] <= temps[1], temps
+
+
+def test_accum_grads_accumulate_in_f32_under_bf16():
+    """The accumulation dtype contract: per-task meta-grads (and their
+    reduction) are f32 even under bf16 compute — the jaxpr's stacked
+    grad leaves carry float32."""
+    cfg = _cfg(compute_dtype="bfloat16", meta_accum_steps=2)
+    state = maml.init_state(cfg)
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg)
+    loss, grads = jax.jit(maml.make_grads_fn(cfg, True))(
+        state, x_s, y_s, x_t, y_t, _weights(cfg)
+    )
+    for part in ("net", "lslr"):
+        for key, leaf in grads[part].items():
+            assert leaf.dtype == jnp.float32, f"{part}.{key}"

@@ -1,0 +1,309 @@
+"""The roofline-driven step autotuner (analysis/autotune.py + cli tune).
+
+Covers the stdlib table half (validate / load / lookup / merge), the
+config consult (``'auto'`` resolution reads the measured winner for this
+device kind + dtype, heuristic fallback otherwise), the roofline
+cross-check, and — slow-marked — the ``cli tune --fast`` end-to-end sweep
+(two real bench.py subprocesses on the CPU backend producing a valid
+table, the CI bench-smoke gate's twin).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.analysis import autotune
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+
+def _valid_table(entries=None):
+    return {
+        "version": autotune.TUNING_VERSION,
+        "entries": entries if entries is not None else {
+            "TPU v5 lite@bfloat16": {
+                "conv_impl": "gemm",
+                "pad_channels": "tile",
+                "remat_policy": "save_conv",
+                "meta_accum_steps": 2,
+                "tasks_per_sec_per_chip": 57.9,
+            },
+        },
+    }
+
+
+def _write(tmp_path, data, name="TUNING.json"):
+    path = os.path.join(str(tmp_path), name)
+    with open(path, "w") as f:
+        json.dump(data, f)
+    autotune.clear_cache()
+    return path
+
+
+# -- table format -------------------------------------------------------------
+
+
+def test_validate_accepts_valid_table():
+    autotune.validate_tuning_table(_valid_table())
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda t: t.update(version=99),
+    lambda t: t.update(entries={}),
+    lambda t: t["entries"].update({"no-at-sign": {
+        "conv_impl": "gemm", "pad_channels": "tile",
+        "remat_policy": "full", "meta_accum_steps": 1,
+        "tasks_per_sec_per_chip": 1.0}}),
+    lambda t: t["entries"]["TPU v5 lite@bfloat16"].update(
+        conv_impl="winograd"),
+    lambda t: t["entries"]["TPU v5 lite@bfloat16"].update(
+        pad_channels="maybe"),
+    lambda t: t["entries"]["TPU v5 lite@bfloat16"].update(
+        remat_policy="sometimes"),
+    lambda t: t["entries"]["TPU v5 lite@bfloat16"].update(
+        meta_accum_steps=0),
+    lambda t: t["entries"]["TPU v5 lite@bfloat16"].update(
+        tasks_per_sec_per_chip=-1),
+])
+def test_validate_rejects_malformed_tables(mutate):
+    table = _valid_table()
+    mutate(table)
+    with pytest.raises(ValueError):
+        autotune.validate_tuning_table(table)
+
+
+def test_load_returns_none_for_missing_or_invalid(tmp_path, capsys):
+    assert autotune.load_tuning_table(
+        os.path.join(str(tmp_path), "absent.json")
+    ) is None
+    bad = _write(tmp_path, {"version": 99, "entries": {}}, "bad.json")
+    assert autotune.load_tuning_table(bad) is None
+    assert "ignoring invalid tuning table" in capsys.readouterr().err
+    good = _write(tmp_path, _valid_table(), "good.json")
+    assert autotune.load_tuning_table(good) is not None
+
+
+def test_tuned_entry_exact_and_substring_match(tmp_path):
+    path = _write(tmp_path, _valid_table())
+    entry = autotune.tuned_entry("TPU v5 lite", "bfloat16", path=path)
+    assert entry is not None and entry["conv_impl"] == "gemm"
+    # relaxed device-kind matching, same as the roofline peak table
+    entry = autotune.tuned_entry(
+        "TPU v5 litepod slice", "bfloat16", path=path
+    )
+    assert entry is not None
+    # dtype must match exactly: a bf16 tuning never serves f32 configs
+    assert autotune.tuned_entry("TPU v5 lite", "float32", path=path) is None
+    assert autotune.tuned_entry("TPU v4", "bfloat16", path=path) is None
+
+
+def test_build_table_picks_best_and_merges():
+    existing = _valid_table()
+    results = [
+        {"value": 10.0, "device_kind": "cpu", "dtype": "float32",
+         "mfu": None, "backend": "cpu", "batch_size": 2, "reduced": True,
+         "point": {"conv_impl": "im2col", "pad_channels": "off",
+                   "remat_policy": "full", "meta_accum_steps": 1}},
+        {"value": 12.5, "device_kind": "cpu", "dtype": "float32",
+         "mfu": None, "backend": "cpu", "batch_size": 2, "reduced": True,
+         "point": {"conv_impl": "gemm", "pad_channels": "off",
+                   "remat_policy": "full", "meta_accum_steps": 2}},
+    ]
+    table = autotune.build_table(results, existing=existing)
+    autotune.validate_tuning_table(table)
+    # the faster point won
+    assert table["entries"]["cpu@float32"]["conv_impl"] == "gemm"
+    assert table["entries"]["cpu@float32"]["meta_accum_steps"] == 2
+    # the foreign device entry survived the merge
+    assert "TPU v5 lite@bfloat16" in table["entries"]
+
+
+def test_build_table_reduced_sweep_never_clobbers_full_entry(capsys):
+    """A --fast (reduced-workload) smoke on an already-tuned host must
+    keep the full-workload entry — the smoke proves the harness, not the
+    tuning."""
+    existing = {
+        "version": autotune.TUNING_VERSION,
+        "entries": {
+            "cpu@float32": {
+                "conv_impl": "gemm", "pad_channels": "tile",
+                "remat_policy": "save_conv", "meta_accum_steps": 4,
+                "tasks_per_sec_per_chip": 50.0, "reduced": False,
+            },
+        },
+    }
+    smoke = [{
+        "value": 99.0, "device_kind": "cpu", "dtype": "float32",
+        "reduced": True, "backend": "cpu", "batch_size": 2, "mfu": None,
+        "point": {"conv_impl": "im2col", "pad_channels": "off",
+                  "remat_policy": "full", "meta_accum_steps": 1},
+    }]
+    table = autotune.build_table(smoke, existing=existing)
+    assert table["entries"]["cpu@float32"]["conv_impl"] == "gemm"
+    assert "keeping the existing full-workload entry" in (
+        capsys.readouterr().err
+    )
+    # a reduced sweep may still replace a reduced (or absent) entry
+    table = autotune.build_table(smoke, existing=None)
+    assert table["entries"]["cpu@float32"]["conv_impl"] == "im2col"
+
+
+def test_build_table_records_the_clamped_accum_bench_measured():
+    """bench.py clamps a point's accum to the largest batch divisor and
+    reports the clamped value in its line; the table must record what was
+    MEASURED, not what was requested."""
+    rec = {
+        "value": 10.0, "device_kind": "cpu", "dtype": "float32",
+        "reduced": True, "backend": "cpu", "batch_size": 6, "mfu": None,
+        "meta_accum_steps": 2,  # bench clamped the requested 4 to 2
+        "point": {"conv_impl": "im2col", "pad_channels": "off",
+                  "remat_policy": "full", "meta_accum_steps": 4},
+    }
+    table = autotune.build_table([rec])
+    assert table["entries"]["cpu@float32"]["meta_accum_steps"] == 2
+
+
+def test_cross_check_roofline_flags_disagreement():
+    def rec(value, predicted, **point):
+        base = {"conv_impl": "gemm", "pad_channels": "off",
+                "remat_policy": "full", "meta_accum_steps": 1}
+        base.update(point)
+        return {
+            "value": value, "batch_size": 4, "n_chips": 1,
+            "roofline": {"predicted_step_seconds": predicted},
+            "point": base,
+        }
+
+    agree = autotune.cross_check_roofline(
+        [rec(10.0, 0.4), rec(20.0, 0.2, meta_accum_steps=2)]
+    )
+    assert agree["winner_agrees_with_roofline"] is True
+    disagree = autotune.cross_check_roofline(
+        [rec(10.0, 0.2), rec(20.0, 0.4, meta_accum_steps=2)]
+    )
+    assert disagree["winner_agrees_with_roofline"] is False
+    assert disagree["predicted_winner"].startswith("conv_impl=gemm")
+
+
+def test_measured_step_seconds():
+    assert autotune.measured_step_seconds(
+        {"value": 8.0, "batch_size": 4, "n_chips": 1}
+    ) == pytest.approx(0.5)
+    assert autotune.measured_step_seconds({"value": None}) is None
+
+
+def test_sweep_points_fast_and_full():
+    fast = autotune.sweep_points(fast=True)
+    assert len(fast) == 2
+    full = autotune.sweep_points(fast=False)
+    # conv_impl x pad x remat x accum
+    assert len(full) == 3 * 2 * 2 * 3
+    for p in full + fast:
+        assert set(p) == set(autotune.SWEEP_KNOBS)
+
+
+# -- config consult -----------------------------------------------------------
+
+
+def _cpu_table(tmp_path, conv_impl="gemm", pad="tile", name="t.json"):
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    return _write(tmp_path, _valid_table({
+        autotune.table_key(kind, "float32"): {
+            "conv_impl": conv_impl,
+            "pad_channels": pad,
+            "remat_policy": "save_conv",
+            "meta_accum_steps": 2,
+            "tasks_per_sec_per_chip": 123.4,
+        },
+    }), name)
+
+
+def test_auto_resolution_consults_tuning_table(tmp_path, monkeypatch):
+    """`'auto'` resolves through the table: the measured winner for this
+    device kind + dtype beats the heuristic (CPU heuristic would say
+    im2col/off; a table saying gemm/tile wins)."""
+    path = _cpu_table(tmp_path)
+    monkeypatch.setenv(autotune.TUNING_TABLE_ENV, path)
+    autotune.clear_cache()
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.resolved_conv_impl == "gemm"
+    assert cfg.resolved_pad_channels == "tile"
+    # explicit knobs still beat the table
+    assert cfg.replace(conv_impl="lax").resolved_conv_impl == "lax"
+    assert cfg.replace(pad_channels="off").resolved_pad_channels == "off"
+
+
+def test_auto_resolution_falls_back_to_heuristic(tmp_path, monkeypatch):
+    """No table / no entry / wrong dtype => the PR-4 heuristic (im2col +
+    off on the CPU test backend)."""
+    monkeypatch.setenv(
+        autotune.TUNING_TABLE_ENV, os.path.join(str(tmp_path), "none.json")
+    )
+    autotune.clear_cache()
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.resolved_conv_impl == "im2col"
+    assert cfg.resolved_pad_channels == "off"
+    # entry pinned for bf16 only: an f32 config keeps the heuristic
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    path = _write(tmp_path, _valid_table({
+        autotune.table_key(kind, "bfloat16"): {
+            "conv_impl": "gemm", "pad_channels": "tile",
+            "remat_policy": "full", "meta_accum_steps": 1,
+            "tasks_per_sec_per_chip": 9.0,
+        },
+    }), "bf16only.json")
+    monkeypatch.setenv(autotune.TUNING_TABLE_ENV, path)
+    autotune.clear_cache()
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.resolved_conv_impl == "im2col"
+    cfg_bf16 = MAMLConfig(
+        dataset_name="omniglot_dataset", compute_dtype="bfloat16"
+    )
+    assert cfg_bf16.resolved_conv_impl == "gemm"
+
+
+def test_corrupt_table_degrades_to_heuristic(tmp_path, monkeypatch):
+    path = os.path.join(str(tmp_path), "corrupt.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    monkeypatch.setenv(autotune.TUNING_TABLE_ENV, path)
+    autotune.clear_cache()
+    cfg = MAMLConfig(dataset_name="omniglot_dataset")
+    assert cfg.resolved_conv_impl == "im2col"  # CPU heuristic, no crash
+
+
+# -- cli tune end to end ------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_tune_fast_emits_valid_table(tmp_path):
+    """The CI gate's twin: `cli tune --fast` runs the 2-point sweep with
+    real bench.py subprocesses on the CPU backend and writes a valid
+    device-keyed table whose entry the config consult then picks up."""
+    out = os.path.join(str(tmp_path), "TUNING.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(autotune.TUNING_TABLE_ENV, None)
+    r = subprocess.run(
+        [sys.executable, "-m", "howtotrainyourmamlpytorch_tpu.cli",
+         "tune", "--fast", "--out", out, "--json"],
+        capture_output=True, text=True, timeout=1200, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(out) as f:
+        table = json.load(f)
+    autotune.validate_tuning_table(table)
+    payload = json.loads(r.stdout)
+    assert payload["table_path"] == out
+    assert len(payload["ranking"]) >= 1
+    # the CPU entry is keyed by the live device kind and resolvable
+    autotune.clear_cache()
+    entry = autotune.tuned_entry("cpu", "float32", table=table)
+    assert entry is not None
+    assert entry["conv_impl"] in ("lax", "im2col", "gemm")

@@ -485,6 +485,17 @@ def test_builder_telemetry_e2e_smoke(tmp_path):
         for lrs in rec["lslr"].values():
             assert np.asarray(lrs).shape == (2, n_steps + 1)
         assert np.asarray(rec["msl_weights"]).shape == (2, n_steps)
+    # the per-epoch dispatch record carries the schema-v7 overlap fields:
+    # the boundary train-summary ran under the in-flight eval tail
+    # (overlap_ms measured) and the phase-transition lag blocks were
+    # skipped at both edges of each boundary
+    disp_recs = [r for r in recs if r["kind"] == "dispatch"]
+    assert disp_recs
+    for rec in disp_recs:
+        assert rec.get("accum_steps") == 1
+        assert isinstance(rec.get("boundary_overlaps"), int)
+        assert isinstance(rec.get("overlap_ms"), (int, float))
+    assert sum(r["boundary_overlaps"] for r in disp_recs) > 0
     # per-epoch records carry the CSV row's scalars + the stream stats
     epoch_recs = [r for r in recs if r["kind"] == "epoch"]
     assert len(epoch_recs) == 2
@@ -668,6 +679,34 @@ def test_v6_elastic_record_kind_validates():
             "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "elastic",
             "iter": 6,
         })
+
+
+def test_validate_file_accepts_v6_era_fixture():
+    """The pinned v6-era log (written before the v7 dispatch-overlap
+    fields existed) validates unchanged under the v7 validator — the
+    backward half of the version contract: v7 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v6_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 7
+
+
+def test_v7_dispatch_overlap_fields_validate():
+    """The schema v7 addition: `dispatch` records may carry the
+    epoch-boundary overlap fields (overlap_ms / boundary_overlaps /
+    accum_steps) — optional, so a v7 record without them (a run whose
+    boundary never overlapped) and a pre-v7 record both stay valid."""
+    tel.validate_record(tel.make_record(
+        "dispatch", epoch=3, train_step_time_ms=41.0,
+        overlap_ms=12.5, boundary_overlaps=2, accum_steps=4,
+    ))
+    tel.validate_record(tel.make_record(
+        "dispatch", epoch=3, train_step_time_ms=41.0,
+        overlap_ms=None, boundary_overlaps=0, accum_steps=1,
+    ))
+    tel.validate_record(tel.make_record(
+        "dispatch", epoch=3, train_step_time_ms=41.0,
+    ))
 
 
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------

@@ -282,6 +282,44 @@ def test_summary_without_audit_record_omits_audit_line(tmp_path, capsys):
     assert "audit:" not in capsys.readouterr().out
 
 
+def test_summary_surfaces_overlap_line(tmp_path, capsys):
+    """Schema v7: `summary` condenses the dispatch records' epoch-boundary
+    overlap fields into the overlap line — mean/total hidden milliseconds,
+    skipped phase-transition blocks, and the accumulation setting."""
+    records = _run_records([0.5])
+    records.insert(-1, make_record(
+        "dispatch", epoch=0, train_step_time_ms=10.0,
+        overlap_ms=12.5, boundary_overlaps=2, accum_steps=4,
+    ))
+    records.insert(-1, make_record(
+        "dispatch", epoch=1, train_step_time_ms=10.0,
+        overlap_ms=7.5, boundary_overlaps=2, accum_steps=4,
+    ))
+    log = _write_log(tmp_path / "t.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["overlap"]["overlap_ms_mean"] == 10.0
+    assert payload["overlap"]["overlap_ms_total"] == 20.0
+    assert payload["overlap"]["boundary_overlaps_total"] == 4
+    assert payload["overlap"]["accum_steps"] == 4
+    assert cli_main(["summary", log]) == 0
+    out = capsys.readouterr().out
+    assert "overlap: boundary overlap 10.0ms/epoch" in out
+    assert "(20.0ms total hidden)" in out
+    assert "4 phase-transition block(s) skipped" in out
+    assert "accum_steps=4" in out
+
+
+def test_summary_pre_v7_log_omits_overlap_line(tmp_path, capsys):
+    """A log whose dispatch records predate the v7 fields gets no overlap
+    line (and a null payload entry) — never a crash."""
+    log = _write_log(tmp_path / "old.jsonl", _run_records([0.5]))
+    assert cli_main(["summary", log, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["overlap"] is None
+    assert cli_main(["summary", log]) == 0
+    assert "overlap:" not in capsys.readouterr().out
+
+
 def test_summary_without_retraces_prints_no_analysis_line(tmp_path, capsys):
     log = _write_log(tmp_path / "t.jsonl", _run_records([0.5]))
     assert cli_main(["summary", log]) == 0
