@@ -489,6 +489,59 @@ def _measure_health_overhead(
     )
 
 
+def _measure_serving(cfg, reduced: bool) -> dict | None:
+    """Adapt-on-request serving latency/throughput on the flagship task
+    geometry (ROADMAP item 1): a ``ServingEngine`` over a fresh snapshot
+    is warmed (every bucket compiled), then driven closed-loop with a
+    mixed tenant-group schedule — reporting ``adaptation_latency_ms``
+    p50/p95 (end-to-end dispatch: upload + adapt-then-predict + result
+    readback) and ``tenants_per_sec``, under the engine's strict
+    zero-retrace gate. Informational like ``epoch_boundary`` — never part
+    of baseline comparability. Best-effort: any failure returns None with
+    a stderr note rather than killing the bench line.
+    """
+    try:
+        from howtotrainyourmamlpytorch_tpu.core import maml
+        from howtotrainyourmamlpytorch_tpu.serving.batcher import (
+            serve_requests,
+        )
+        from howtotrainyourmamlpytorch_tpu.serving.bench import _synth_groups
+        from howtotrainyourmamlpytorch_tpu.serving.engine import ServingEngine
+
+        rounds = int(
+            os.environ.get("BENCH_SERVING_ROUNDS", "1" if reduced else "4")
+        )
+        scfg = cfg.replace(
+            serving_bucket_ladder=[1, 2] if reduced else [1, 4, 8],
+            serving_max_tenants_per_dispatch=2 if reduced else 8,
+        )
+        engine = ServingEngine(scfg, maml.init_state(scfg))
+        warmup_s = engine.warmup()
+        shots = (scfg.num_samples_per_class,)
+        n_requests = rounds * sum(
+            range(1, engine.max_tenants + 1)
+        )
+        for group in _synth_groups(
+            scfg, shots, n_requests, engine.max_tenants, seed=0
+        ):
+            serve_requests(engine, group)
+        rollup = engine.rollup()
+        return {
+            "adaptation_latency_ms_p50": rollup["adapt_ms_p50"],
+            "adaptation_latency_ms_p95": rollup["adapt_ms_p95"],
+            # the engine rollup's span-based definition, verbatim
+            "tenants_per_sec": rollup["tenants_per_sec"],
+            "dispatches": rollup["dispatches"],
+            "tenants": rollup["tenants"],
+            "retraces": rollup["retraces"],
+            "warmup_seconds": round(warmup_s, 3),
+            "bucket_ladder": list(engine.buckets),
+        }
+    except Exception as e:  # noqa: BLE001 - informational metric only
+        print(f"bench: serving measurement failed ({e!r})", file=sys.stderr)
+        return None
+
+
 # BENCH_* env vars that change WHAT is measured (workload shapes or
 # lowering); a run with any of these set must never refresh the baseline
 _WORKLOAD_KNOBS = (
@@ -714,6 +767,12 @@ def main() -> None:
             elapsed / timed_steps * 1e3, reduced,
         )
 
+    # adapt-on-request serving latency p50/p95 + tenants/sec (serving/):
+    # null when skipped or unmeasurable
+    serving = None
+    if os.environ.get("BENCH_SKIP_SERVING") != "1":
+        serving = _measure_serving(cfg, reduced)
+
     peak = _peak_flops(device_kind, cfg.compute_dtype)
     # mfu: the convention — *algorithmic* model FLOPs (analytic count, no
     # recompute) over peak. hfu: *executed* FLOPs per XLA's cost analysis of
@@ -813,6 +872,10 @@ def main() -> None:
         # step time with health_level='monitor' vs off (informational —
         # not part of baseline comparability)
         "health_overhead": health_overhead,
+        # adapt-on-request serving: adaptation_latency_ms p50/p95 and
+        # tenants_per_sec under the strict zero-retrace gate
+        # (informational — not part of baseline comparability)
+        "serving": serving,
         # pinned workload descriptor: makes round-over-round lines
         # self-describing so a knob-default change can never silently turn
         # the driver series into an apples-to-oranges trend
@@ -869,8 +932,8 @@ def main() -> None:
             if k not in ("vs_baseline", "baseline_backend",
                          "baseline_refreshed", "epoch_boundary",
                          "input_pipeline", "telemetry_overhead",
-                         "health_overhead", "hlo_cost", "donation",
-                         "roofline")
+                         "health_overhead", "serving", "hlo_cost",
+                         "donation", "roofline")
         }
         with open(baseline_path, "w") as f:
             json.dump(baseline_out, f, indent=1)

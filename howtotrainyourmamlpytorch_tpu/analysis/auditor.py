@@ -15,9 +15,12 @@ Two entry points:
 * ``audit_system_programs(cfg)`` — the canonical program family: the four
   train-step jits (plain / multi / indexed / multi-indexed, the same
   factories ``experiment/system.py`` jits with ``maml.TRAIN_DONATE``),
-  the fused eval multi-step, and the device-pipeline index expander.
-  Driven by ``cli audit``, the builder's build-time audit
-  (``analysis_level != 'off'``) and the contract tests.
+  the fused eval multi-step, the device-pipeline index expander, and the
+  multi-tenant serving step (``maml.make_serve_step``, jitted with
+  ``maml.SERVE_DONATE`` exactly like ``serving/engine.py`` — its
+  donation contract is the state passthrough alias). Driven by ``cli
+  audit``, the builder's build-time audit (``analysis_level != 'off'``)
+  and the contract tests.
 * ``RetraceDetector`` — the runtime half: hashes the abstract signature
   (treedef + leaf shapes/dtypes) of every dispatch at its site; a second
   distinct signature at one site is a mid-run retrace (a new 20-40s TPU
@@ -329,9 +332,12 @@ def audit_system_programs(
 
     Returns one ``AuditReport`` per program: the four train-step jits
     (each built with ``maml.TRAIN_DONATE`` exactly like
-    ``experiment/system.py``), the fused eval multi-step, and the
-    device-pipeline index expander. ``k`` is the fused-dispatch chunk
-    used for the multi variants; ``programs`` filters by name.
+    ``experiment/system.py``), the fused eval multi-step, the
+    device-pipeline index expander, and the multi-tenant serving step
+    (built with ``maml.SERVE_DONATE`` exactly like ``serving/engine.py``;
+    audited at the config's batch_size as its tenant bucket). ``k`` is
+    the fused-dispatch chunk used for the multi variants; ``programs``
+    filters by name.
     """
     auditor = auditor or ProgramAuditor(cfg)
     so = cfg.second_order if second_order is None else bool(second_order)
@@ -385,6 +391,13 @@ def audit_system_programs(
             jax.jit(device_pipeline.make_index_expander(cfg, augment=False)),
             (store, gather, rot_k),
             (),
+        ),
+        (
+            f"serve_step[b={cfg.batch_size}]",
+            jax.jit(maml.make_serve_step(cfg),
+                    donate_argnums=maml.SERVE_DONATE),
+            (state, *batch, _sds((cfg.batch_size,), jnp.float32)),
+            maml.SERVE_DONATE,
         ),
     ]
     reports = []

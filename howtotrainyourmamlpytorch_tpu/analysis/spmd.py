@@ -7,7 +7,7 @@ decide whether a pod reservation survives its first hour: does
 store?  Are the batch arguments actually sharded over ``(data, task)`` or
 is every device redundantly computing the global batch?  Will this config
 OOM per-device before the first checkpoint?  This module compiles the
-canonical six-program family **under a real mesh** (8 fake CPU devices
+canonical seven-program family **under a real mesh** (8 fake CPU devices
 via ``--xla_force_host_platform_device_count`` in tests/CI, real chips on
 hardware) and verifies, per ``program@backend@mesh`` key pinned in
 ``CONTRACTS.json``:
@@ -386,7 +386,7 @@ def audit_spmd_programs(
     k: int = 2,
     programs: Optional[Sequence[str]] = None,
 ) -> List[C.SpmdAuditReport]:
-    """Audit the canonical six-program family under ``mesh`` (default: a
+    """Audit the canonical seven-program family under ``mesh`` (default: a
     1xN hybrid mesh over every visible device). The batch size is rounded
     up to the mesh size when it does not divide it — the audit needs a
     shardable batch, and the census keys carry the mesh so rounded and
@@ -481,6 +481,19 @@ def audit_spmd_programs(
             # outputs are the expanded per-task pixel batches: sharded over
             # the task axis BY DESIGN
             (), False, store_bytes,
+        ),
+        (
+            f"serve_step[b={cfg.batch_size}]",
+            jax.jit(maml.make_serve_step(cfg),
+                    donate_argnums=maml.SERVE_DONATE),
+            (state, *batch,
+             _sharded(jax.ShapeDtypeStruct((cfg.batch_size,), jnp.float32),
+                      mesh, BATCH0)),
+            (rp, b0, b0, b0, b0, b0),
+            # per-tenant outputs (preds/loss/accuracy) are sharded over
+            # the tenant axis BY DESIGN; the passthrough state keeps its
+            # replicated input sharding
+            maml.SERVE_DONATE, False, 0,
         ),
     ]
     reports = []

@@ -31,6 +31,18 @@ HBM budget / roofline) with the family compiled under a real hybrid
     python -m howtotrainyourmamlpytorch_tpu.cli audit [--pin]
     python -m howtotrainyourmamlpytorch_tpu.cli audit --mesh 1x8 [--pin]
 
+The ``serve-bench`` subcommand (serving/bench.py — needs jax) is the
+closed-loop load generator for the adapt-on-request serving engine: it
+drives mixed-bucket synthetic traffic through a ``ServingEngine`` under a
+strict retrace gate and prints one JSON line with adaptation-latency
+p50/p95 and tenants/sec (optionally writing schema-v8 ``serving``
+telemetry records with ``--telemetry PATH``):
+
+    python -m howtotrainyourmamlpytorch_tpu.cli serve-bench --fast
+    python -m howtotrainyourmamlpytorch_tpu.cli serve-bench \
+        --config experiment_config/exp.json \
+        --checkpoint experiment/saved_models --telemetry /tmp/serving.jsonl
+
 The ``tune`` subcommand (analysis/autotune.py) is the roofline-driven
 step autotuner: it sweeps (conv_impl x pad_channels x remat_policy x
 meta_accum_steps) with bench.py's harness (one subprocess per point),
@@ -122,6 +134,12 @@ def main(argv=None):
         from .tools.audit_cli import main as audit_main
 
         raise SystemExit(audit_main(args[1:]))
+    if args and args[0] == "serve-bench":
+        # closed-loop load generator for the adapt-on-request serving
+        # engine (serving/bench.py — compiles programs: needs jax)
+        from .serving.bench import main as serve_bench_main
+
+        raise SystemExit(serve_bench_main(args[1:]))
     if args and args[0] == "tune":
         # roofline-driven step autotuner: jax-free in THIS process (every
         # sweep point is a bench.py subprocess), so dispatch before the

@@ -342,6 +342,28 @@ class MAMLConfig:
     # former unbounded spin-wait.
     ckpt_follower_timeout_s: float = 600.0
 
+    # --- serving (serving/) -----------------------------------------------
+    # tenant-count bucket ladder for the adapt-on-request serving engine
+    # (serving/engine.py): every dispatch is padded up to the smallest
+    # ladder entry >= its tenant count, so steady-state traffic cycles
+    # through a FIXED set of compiled programs (one per bucket x shots
+    # value) and never retraces — the engine runs a strict RetraceDetector
+    # to enforce it. Must be strictly increasing positive ints; pad
+    # tenants are masked out of the aggregate metrics (core/maml.py,
+    # make_serve_step) and cannot perturb real tenants' outputs.
+    serving_bucket_ladder: List[int] = field(
+        default_factory=lambda: [1, 2, 4, 8]
+    )
+    # micro-batching front end (serving/batcher.py): a queued request is
+    # dispatched when serving_max_tenants_per_dispatch requests of its
+    # shots bucket are waiting OR the oldest has waited this long —
+    # the latency/throughput knob of the serving path. 0 dispatches
+    # immediately (bucket-of-one latency floor).
+    serving_max_wait_ms: float = 5.0
+    # cap on the tenants one serving dispatch carries; must not exceed
+    # the ladder's top bucket (every full group must fit a bucket)
+    serving_max_tenants_per_dispatch: int = 8
+
     # --- static analysis (analysis/) --------------------------------------
     # program-contract audits + runtime retrace detection:
     # 'off'    — (default) nothing installed; the jitted programs and the
@@ -542,6 +564,51 @@ class MAMLConfig:
             raise ValueError(
                 f"telemetry_level must be 'off', 'scalars' or 'dynamics', "
                 f"got {self.telemetry_level!r}"
+            )
+        # serving knobs: the ladder must be strictly increasing positive
+        # ints (JSON configs may carry integral floats — coerce), and
+        # every full batcher group must fit the top bucket
+        ladder = self.serving_bucket_ladder
+        if isinstance(ladder, list):
+            self.serving_bucket_ladder = ladder = [
+                int(v) if isinstance(v, float) and v.is_integer() else v
+                for v in ladder
+            ]
+        if (
+            not isinstance(ladder, list)
+            or not ladder
+            or not all(
+                isinstance(v, int) and not isinstance(v, bool) and v >= 1
+                for v in ladder
+            )
+            or any(a >= b for a, b in zip(ladder, ladder[1:]))
+        ):
+            raise ValueError(
+                "serving_bucket_ladder must be a non-empty strictly "
+                f"increasing list of positive ints, got {ladder!r}"
+            )
+        if self.serving_max_wait_ms < 0:
+            raise ValueError(
+                f"serving_max_wait_ms must be >= 0 (0 dispatches "
+                f"immediately), got {self.serving_max_wait_ms}"
+            )
+        # same integral-float coercion as the ladder (JSON round-trips)
+        if isinstance(
+            self.serving_max_tenants_per_dispatch, float
+        ) and self.serving_max_tenants_per_dispatch.is_integer():
+            self.serving_max_tenants_per_dispatch = int(
+                self.serving_max_tenants_per_dispatch
+            )
+        if not (
+            isinstance(self.serving_max_tenants_per_dispatch, int)
+            and not isinstance(self.serving_max_tenants_per_dispatch, bool)
+            and 1 <= self.serving_max_tenants_per_dispatch <= ladder[-1]
+        ):
+            raise ValueError(
+                "serving_max_tenants_per_dispatch must be an int in "
+                f"[1, max(serving_bucket_ladder)={ladder[-1]}] so every "
+                "full dispatch group fits a bucket, got "
+                f"{self.serving_max_tenants_per_dispatch!r}"
             )
         if self.analysis_level not in ("off", "warn", "strict"):
             raise ValueError(

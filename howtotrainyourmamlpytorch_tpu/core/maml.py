@@ -681,6 +681,92 @@ def make_eval_step(cfg: MAMLConfig, decode_uint8: Optional[bool] = None):
     return eval_step
 
 
+# -- serving (adapt-on-request meta-inference) -------------------------------
+#
+# The serving hot path is the SAME fused adapt-then-predict program eval
+# runs, with the meta-batch axis repurposed as a concurrent-TENANT axis:
+# many users' support sets ride one dispatch, each adapting its own weight
+# clone under vmap. Unlike eval, the serve step (a) passes the state
+# THROUGH as an output so it can be donated — the executable aliases the
+# state buffers input->output (verified by the donation contract:
+# alias_size_bytes == state bytes), the engine re-binds its reference per
+# dispatch, and params + LSLR + BN stay single-buffered in HBM exactly
+# like the train family — and (b) takes a per-tenant ``valid`` mask so
+# PAD tenants (the batcher rounds partial dispatches up to a static
+# bucket) cannot perturb the aggregate metrics; per-tenant outputs are
+# untouched by padding by construction (vmap tasks are independent
+# chains), which the serving bit-exactness tests pin.
+SERVE_DONATE = (0,)
+
+
+def make_serve_step(cfg: MAMLConfig):
+    """Build the adapt-then-predict serving step.
+
+    Signature: (state, x_s, y_s, x_t, y_t, valid) -> (state, out) where
+    the batch arguments carry a leading TENANT axis of some static bucket
+    width, ``valid`` is the float32 (bucket,) METRIC mask — 1 for a
+    tenant whose query labels are real, 0 for pad tenants AND for
+    label-free tenants whose ``y_t`` slot holds fabricated zeros (scoring
+    those would poison the aggregate; their predictions are unaffected) —
+    the returned state is the input state passed through (donated:
+    ``SERVE_DONATE``), and ``out`` holds the per-tenant results —
+    ``preds`` (bucket, way * targets, classes) softmax (the query stream
+    flattened class-major, the eval path's layout), ``loss`` /
+    ``accuracy`` (bucket,) — plus ``metrics``: the masked tenant-mean loss/accuracy
+    (masked-out tenants contribute exactly zero; all-masked dispatches
+    report 0 by the clamped denominator).
+
+    The per-tenant math is the eval program's verbatim — same
+    ``_task_learner`` (first order, ``number_of_evaluation_steps_per_iter``
+    inner steps, final-step-only loss weights), same matmul-precision
+    scope — so serving predictions are bit-exact with
+    ``make_eval_step`` / ``make_eval_multi_step`` outputs at the same
+    tenant width (tests/test_serving.py). The ``optimization_barrier``
+    materializes the per-tenant stacks before the masked reductions, so
+    the extra consumers the mask introduces can never perturb the
+    per-task codegen the equivalence rests on (same discipline as the
+    indexed train factories).
+
+    Batches arrive as float32 host pixels (the request frontend assembles
+    NHWC float32; the uint8 serving ingest tier is future work), so the
+    uint8_stream decode prelude is deliberately NOT applied here.
+    """
+    num_steps = cfg.number_of_evaluation_steps_per_iter
+    learner = _task_learner(cfg, num_steps, second_order=False)
+    loss_weights = jnp.asarray(msl_lib.final_step_only(num_steps))
+
+    def serve_step(state: MetaState, x_s, y_s, x_t, y_t, valid):
+        # same per-step precision scoping as train/eval (see train_step)
+        with jax.default_matmul_precision(cfg.resolved_matmul_precision):
+            losses, (correct, _, preds, _) = _map_tasks(
+                lambda xs, ys, xt, yt: learner(
+                    state.net, state.lslr, state.bn, xs, ys, xt, yt,
+                    loss_weights
+                ),
+                cfg.task_axis_mode, x_s, y_s, x_t, y_t,
+            )
+            losses, correct, preds = jax.lax.optimization_barrier(
+                (losses, correct, preds)
+            )
+            mask = valid.astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+            per_tenant_acc = jnp.mean(correct, axis=-1)
+            out = {
+                "preds": preds,
+                "loss": losses,
+                "accuracy": per_tenant_acc,
+                "metrics": {
+                    "loss": jnp.sum(
+                        losses.astype(jnp.float32) * mask
+                    ) / denom,
+                    "accuracy": jnp.sum(per_tenant_acc * mask) / denom,
+                },
+            }
+            return state, out
+
+    return serve_step
+
+
 # -- device-resident (index-only H2D) step variants -------------------------
 #
 # ``data_placement='device'``: the split's uint8 image store is resident in

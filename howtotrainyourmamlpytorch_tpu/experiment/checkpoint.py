@@ -489,23 +489,49 @@ def save_checkpoint_async(
     return path
 
 
+def _resolve_readonly_path(path: str) -> str:
+    """The directory a READ-ONLY load should restore from, with no
+    filesystem mutation: ``path`` itself when it exists, else the
+    complete ``<path>.old`` a swap killed between its two renames left
+    behind (see ``_swap_into_place``). The training-owned load path
+    instead *renames* the ``.old`` back into place
+    (``_recover_interrupted_swap``) — a mutation a serving reader of a
+    live training run's directory must never perform: the training
+    process owns that recovery, and racing it from a second process
+    turns a crash-forensics rename into a cross-process rename race."""
+    old = path + ".old"
+    if not os.path.isdir(path) and os.path.isdir(old):
+        return old
+    return path
+
+
 def load_checkpoint(
     model_save_dir: str,
     model_name: str,
     model_idx,
     target_state: MetaState,
+    readonly: bool = False,
 ) -> Tuple[MetaState, Dict[str, Any]]:
     """Restore (ref: load_model, few_shot_learning_system.py:410-424).
 
     :param target_state: a state of the right structure (e.g. from
-        ``maml.init_state``) providing shapes/dtypes for orbax.
+        ``maml.init_state`` or ``jax.eval_shape`` of it) providing
+        shapes/dtypes for orbax.
+    :param readonly: never mutate the checkpoint directory — the serving
+        path's contract (serving/engine.py): a crash-leftover ``.old``
+        sibling is *read from* instead of renamed back into place, and
+        the load performs no write of any kind in ``model_save_dir``.
+        The default (training-owned) path keeps the recovery rename.
     """
     wait_for_pending()  # never read past an in-flight async save
     faults.fire("ckpt_restore")  # injectable seam (resilience/faults.py)
     if jax.process_count() > 1:
         _reroute_orbax_sync_through_coordination_service()
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
-    _recover_interrupted_swap(path)
+    if readonly:
+        path = _resolve_readonly_path(path)
+    else:
+        _recover_interrupted_swap(path)
     # restore template: HOST numpy arrays, not ShapeDtypeStructs. A
     # ShapeDtypeStruct template makes orbax rebuild each leaf's recorded
     # jax sharding — which names the devices of the gang that WROTE the

@@ -1,0 +1,34 @@
+"""Adapt-on-request meta-inference serving (ROADMAP item 1).
+
+MAML's value at inference time is per-request adaptation: every request
+carries a small support set, and the server must run a compiled inner
+loop — not a plain forward — before it can predict on the query set.
+This package turns the training stack's vmap task axis into a
+concurrent-TENANT axis and serves that adapt-then-predict program as a
+request-driven hot path:
+
+* :mod:`serving.engine`  — ``ServingEngine``: loads a training checkpoint
+  (read-only) into a servable snapshot, pre-compiles the donated
+  ``core.maml.make_serve_step`` program for every (tenant-bucket, shots)
+  point of the static bucket ladder at startup (warm-started from the
+  persistent ``xla_cache`` when configured), and dispatches padded,
+  masked multi-tenant batches under a strict ``RetraceDetector``;
+* :mod:`serving.batcher` — the host-side micro-batching front end:
+  per-shots-bucket queues with ``serving_max_wait_ms`` /
+  ``serving_max_tenants_per_dispatch`` knobs (``MicroBatcher``), plus the
+  synchronous ``serve_requests`` API;
+* :mod:`serving.bench`   — the ``cli serve-bench`` closed-loop load
+  generator (latency p50/p95 + tenants/sec, telemetry ``serving``
+  records).
+"""
+
+from .batcher import AdaptRequest, MicroBatcher, serve_requests
+from .engine import ServingEngine, load_servable_snapshot
+
+__all__ = [
+    "AdaptRequest",
+    "MicroBatcher",
+    "ServingEngine",
+    "load_servable_snapshot",
+    "serve_requests",
+]

@@ -1,0 +1,247 @@
+"""Host-side micro-batching front end for the serving engine.
+
+Two entry points share the grouping policy:
+
+* ``serve_requests(engine, requests)`` — SYNCHRONOUS closed-loop API:
+  partition a request list into per-shots groups of at most
+  ``serving_max_tenants_per_dispatch``, dispatch each group through
+  ``ServingEngine.serve_group``, and return results aligned with the
+  input order. The deterministic path — tests, batch jobs, the
+  ``serve-bench`` load generator.
+* ``MicroBatcher`` — the ONLINE front end: ``submit()`` enqueues a
+  request into its shots bucket's queue and returns a handle;
+  a worker thread dispatches a queue when it holds
+  ``serving_max_tenants_per_dispatch`` requests OR its oldest request
+  has waited ``serving_max_wait_ms`` — the classic max-batch/max-wait
+  latency-throughput dial. Per-request queue time rides into the
+  telemetry ``serving`` records as the dispatch's mean ``queue_ms``.
+
+Shots are a BUCKET KEY, never a padding axis: requests with different
+support-shot counts go to different queues and different compiled
+programs (pad support samples would enter the adaptation loss). Tenant
+count IS padded — up to the bucket ladder — with masked zeros the engine
+proves inert.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class AdaptRequest:
+    """One tenant's adapt-then-predict request.
+
+    Arrays are NHWC float32 / int32, matching the engine config's task
+    geometry: ``support_x`` (way, shots, h, w, c), ``support_y``
+    (way, shots), ``query_x`` (way, targets, h, w, c), and optionally
+    ``query_y`` (way, targets) when the caller wants query loss/accuracy
+    back (predictions never need labels).
+    """
+
+    support_x: np.ndarray
+    support_y: np.ndarray
+    query_x: np.ndarray
+    query_y: Optional[np.ndarray] = None
+    tenant_id: Optional[str] = None
+
+    @property
+    def shots(self) -> int:
+        return int(np.asarray(self.support_x).shape[1])
+
+
+def group_requests(
+    requests: Sequence[AdaptRequest], max_tenants: int
+) -> List[List[int]]:
+    """The shared grouping policy: stable-partition request INDICES by
+    shots bucket, then chunk each partition at ``max_tenants``. Order is
+    preserved within a bucket, so results can be re-aligned by index."""
+    if max_tenants < 1:
+        raise ValueError(f"max_tenants must be >= 1, got {max_tenants}")
+    by_shots: Dict[int, List[int]] = {}
+    for i, req in enumerate(requests):
+        by_shots.setdefault(req.shots, []).append(i)
+    groups: List[List[int]] = []
+    for shots in sorted(by_shots):
+        idxs = by_shots[shots]
+        for at in range(0, len(idxs), max_tenants):
+            groups.append(idxs[at:at + max_tenants])
+    return groups
+
+
+def serve_requests(
+    engine, requests: Sequence[AdaptRequest],
+    max_tenants: Optional[int] = None,
+):
+    """Serve a request list synchronously; returns
+    ``(results, dispatches)`` where ``results[i]`` is request i's
+    ``TenantResult`` and ``dispatches`` the per-dispatch
+    ``DispatchResult`` list (latency + masked metrics, in dispatch
+    order)."""
+    cap = engine.max_tenants if max_tenants is None else min(
+        int(max_tenants), engine.max_tenants
+    )
+    results: List[Any] = [None] * len(requests)
+    dispatches = []
+    for idxs in group_requests(requests, cap):
+        dr = engine.serve_group([requests[i] for i in idxs])
+        dispatches.append(dr)
+        for i, res in zip(idxs, dr.results):
+            results[i] = res
+    return results, dispatches
+
+
+@dataclass
+class _Pending:
+    """A submitted request waiting for its dispatch."""
+
+    request: AdaptRequest
+    enqueued: float
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[BaseException] = None
+
+    def get(self, timeout: Optional[float] = None):
+        """Block until the request was served; returns its
+        ``TenantResult`` or re-raises the dispatch's error."""
+        if not self.done.wait(timeout):
+            raise TimeoutError("request not served within timeout")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class MicroBatcher:
+    """Online max-batch / max-wait micro-batcher feeding one engine.
+
+    One worker thread drains per-shots queues: a queue dispatches when
+    it holds ``max_tenants`` requests, or when its oldest request has
+    waited ``max_wait_ms`` (0 => dispatch immediately). ``submit()``
+    returns a ``_Pending`` handle whose ``get()`` blocks for the result.
+    ``close()`` drains every queue, then stops the worker.
+
+    Single-engine, single-worker by design: the engine serializes on the
+    donated state anyway, so one dispatcher thread is the contention-free
+    shape; scale-out is more engines (one per replica), not more threads.
+    """
+
+    def __init__(self, engine, max_tenants: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None):
+        self.engine = engine
+        self.max_tenants = (
+            engine.max_tenants if max_tenants is None
+            else min(int(max_tenants), engine.max_tenants)
+        )
+        if self.max_tenants < 1:
+            # 0 would make every queue "full" with an empty group — the
+            # worker would spin forever and close() would never join
+            raise ValueError(
+                f"max_tenants must be >= 1, got {self.max_tenants}"
+            )
+        self.max_wait_ms = (
+            float(engine.cfg.serving_max_wait_ms)
+            if max_wait_ms is None else float(max_wait_ms)
+        )
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+        self._queues: Dict[int, List[_Pending]] = {}
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="serving-batcher", daemon=True
+        )
+        self._worker.start()
+
+    def submit(self, request: AdaptRequest) -> _Pending:
+        # validate HERE, against the engine geometry, so a malformed
+        # request raises to ITS submitter — deferred to dispatch time it
+        # would fail the whole co-batched group with someone else's
+        # shape error
+        self.engine._validate(request)
+        pending = _Pending(request=request, enqueued=time.perf_counter())
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queues.setdefault(request.shots, []).append(pending)
+            self._cond.notify()
+        return pending
+
+    def close(self) -> None:
+        """Drain every queue, then stop the worker thread."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join()
+
+    # -- worker ------------------------------------------------------------
+
+    def _ripe_group(self) -> Optional[List[_Pending]]:
+        """Pop the ripe queue (full, past its wait deadline, or draining
+        at close) whose HEAD has waited longest — oldest-first across
+        queues, so a saturated low-shots queue can never starve another
+        shots bucket past its max-wait promise (caller holds the lock);
+        None when nothing is ripe yet."""
+        now = time.perf_counter()
+        ripe_shots, oldest = None, None
+        for shots, q in self._queues.items():
+            if not q:
+                continue
+            full = len(q) >= self.max_tenants
+            expired = (now - q[0].enqueued) * 1e3 >= self.max_wait_ms
+            if (full or expired or self._closed) and (
+                oldest is None or q[0].enqueued < oldest
+            ):
+                ripe_shots, oldest = shots, q[0].enqueued
+        if ripe_shots is None:
+            return None
+        q = self._queues[ripe_shots]
+        group = q[:self.max_tenants]
+        self._queues[ripe_shots] = q[self.max_tenants:]
+        return group
+
+    def _next_deadline_s(self) -> Optional[float]:
+        """Seconds until the oldest queued request's wait expires (caller
+        holds the lock); None when every queue is empty."""
+        oldest = min(
+            (q[0].enqueued for q in self._queues.values() if q),
+            default=None,
+        )
+        if oldest is None:
+            return None
+        return max(
+            0.0, self.max_wait_ms / 1e3 - (time.perf_counter() - oldest)
+        )
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                group = self._ripe_group()
+                if group is None:
+                    if self._closed:
+                        return
+                    self._cond.wait(timeout=self._next_deadline_s())
+                    continue
+            # dispatch OUTSIDE the lock: submit() stays non-blocking
+            # while the device works
+            now = time.perf_counter()
+            queue_ms = float(
+                np.mean([(now - p.enqueued) * 1e3 for p in group])
+            )
+            try:
+                dr = self.engine.serve_group(
+                    [p.request for p in group], queue_ms=queue_ms
+                )
+                for p, res in zip(group, dr.results):
+                    p.result = res
+                    p.done.set()
+            except BaseException as e:  # noqa: BLE001 - relayed to callers
+                for p in group:
+                    p.error = e
+                    p.done.set()

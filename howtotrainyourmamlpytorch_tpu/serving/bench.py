@@ -1,0 +1,214 @@
+"""``cli serve-bench`` — closed-loop load generator for the serving path.
+
+Drives a ``ServingEngine`` with synthetic adapt-on-request traffic that
+cycles through MIXED tenant-group sizes (1..max_tenants) and every
+configured shots bucket — the steady-state mixed-bucket pattern the
+zero-retrace contract must hold under (the engine's RetraceDetector runs
+strict: any mid-run recompile fails the bench). Prints ONE JSON line:
+
+.. code-block:: json
+
+   {"metric": "serving_adaptation_latency_ms", "value": <p50>,
+    "unit": "ms", "adaptation_latency_ms_p50": ..., "..._p95": ...,
+    "tenants_per_sec": ..., "dispatches": ..., "tenants": ...,
+    "warmup_seconds": ..., "retraces": 0, "backend": ...,
+    "bucket_ladder": [...], "shots_buckets": [...]}
+
+With ``--telemetry PATH`` the per-dispatch ``serving`` records plus the
+final rollup go to a schema-v8 JSONL log that ``cli inspect summary``
+renders and the CI serving-smoke job schema-validates. ``--checkpoint
+DIR`` serves a real training checkpoint (restored READ-ONLY) instead of
+a fresh ``init_state`` snapshot; ``--fast`` shrinks the workload to a
+seconds-scale smoke (the CI gate).
+
+Exit codes: 0 on success (including the emitted line), nonzero on any
+failure — a retrace, a schema-invalid record, a broken engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def _bench_cfg(args):
+    """The generator's config: the user's JSON when given, else a small
+    deterministic serving config (``--fast`` shrinks it further)."""
+    from ..config import MAMLConfig
+
+    if args.config:
+        cfg = MAMLConfig.from_json_file(args.config)
+    elif args.fast:
+        cfg = MAMLConfig(
+            dataset_name="omniglot_dataset",
+            image_height=10, image_width=10, image_channels=1,
+            num_classes_per_set=3, num_samples_per_class=1,
+            num_target_samples=2, batch_size=2, cnn_num_filters=4,
+            num_stages=2, number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2, use_remat=False,
+            serving_bucket_ladder=[1, 2],
+            serving_max_tenants_per_dispatch=2,
+            compilation_cache_dir="",
+        )
+    else:
+        cfg = MAMLConfig(
+            dataset_name="omniglot_dataset",
+            image_height=28, image_width=28, image_channels=1,
+            num_classes_per_set=5, num_samples_per_class=1,
+            num_target_samples=5, batch_size=8, cnn_num_filters=32,
+            num_stages=4, number_of_training_steps_per_iter=3,
+            number_of_evaluation_steps_per_iter=3,
+            compilation_cache_dir="",
+        )
+    return cfg
+
+
+def _synth_groups(cfg, shots_buckets, n_requests: int, cap: int,
+                  seed: int) -> List[List]:
+    """Deterministic synthetic traffic as DISPATCH GROUPS: group sizes
+    cycle 1..cap (every tenant bucket sees steady traffic) and each
+    group's shots bucket cycles the configured ladder (every compiled
+    program sees steady traffic) — the mixed-bucket pattern the
+    zero-retrace contract must hold under."""
+    from .batcher import AdaptRequest
+
+    rng = np.random.RandomState(seed)
+    n, t = cfg.num_classes_per_set, cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    groups: List[List] = []
+    size, total, g = 1, 0, 0
+    while total < n_requests:
+        take = min(size, n_requests - total)
+        s = shots_buckets[g % len(shots_buckets)]
+        group = []
+        for _ in range(take):
+            group.append(AdaptRequest(
+                support_x=rng.randn(n, s, h, w, c).astype(np.float32),
+                support_y=np.tile(
+                    np.arange(n, dtype=np.int32)[:, None], (1, s)
+                ),
+                query_x=rng.randn(n, t, h, w, c).astype(np.float32),
+                query_y=np.tile(
+                    np.arange(n, dtype=np.int32)[:, None], (1, t)
+                ),
+                tenant_id=f"tenant-{total + len(group)}",
+            ))
+        groups.append(group)
+        total += take
+        g += 1
+        size = size + 1 if size < cap else 1
+    return groups
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="serve-bench",
+        description="Closed-loop load generator for the adapt-on-request "
+                    "serving engine (latency p50/p95, tenants/sec, "
+                    "zero-retrace gate)",
+    )
+    parser.add_argument("--fast", action="store_true",
+                        help="seconds-scale smoke workload (the CI gate)")
+    parser.add_argument("--config", default=None,
+                        help="experiment JSON supplying the geometry and "
+                             "serving_* knobs")
+    parser.add_argument("--checkpoint", default=None, metavar="DIR",
+                        help="serve this saved_models directory's "
+                             "checkpoint (read-only restore) instead of a "
+                             "fresh init_state snapshot; REQUIRES --config "
+                             "with the training run's geometry (the "
+                             "restore template and the compiled programs "
+                             "are built from it — nothing in the "
+                             "checkpoint directory records the config)")
+    parser.add_argument("--model-idx", default="latest",
+                        help="checkpoint index under --checkpoint "
+                             "(default: latest)")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="synthetic requests to serve (default: 8 "
+                             "fast, 64 otherwise)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--telemetry", default=None, metavar="PATH",
+                        help="write serving telemetry records (JSONL, "
+                             "schema v8) to this path")
+    args = parser.parse_args(argv)
+    if args.checkpoint and not args.config:
+        parser.error(
+            "--checkpoint requires --config: the checkpoint directory "
+            "records no geometry, so the restore template and compiled "
+            "programs must come from the training run's experiment JSON "
+            "(a mismatched default config would fail the restore — or, "
+            "worse, silently serve with the wrong inner-step count)"
+        )
+
+    cfg = _bench_cfg(args)
+    n_requests = args.requests or (8 if args.fast else 64)
+    # two shots buckets prove the mixed-bucket no-retrace contract even
+    # on the smoke workload
+    shots_buckets = sorted({cfg.num_samples_per_class,
+                            cfg.num_samples_per_class + 1})
+
+    from ..core import maml
+    from .batcher import serve_requests
+    from .engine import ServingEngine, load_servable_snapshot
+
+    if args.checkpoint:
+        # load_servable_snapshot also points the persistent compilation
+        # cache at the training run's xla_cache (warm-started warmup)
+        state, _ = load_servable_snapshot(
+            cfg, args.checkpoint, args.model_idx
+        )
+    else:
+        state = maml.init_state(cfg)
+
+    sink = None
+    if args.telemetry:
+        from ..telemetry.sinks import JsonlSink
+
+        sink = JsonlSink(args.telemetry)
+
+    engine = ServingEngine(
+        cfg, state, shots_buckets=shots_buckets, sink=sink,
+        strict_retrace=True,
+    )
+    warmup_s = engine.warmup()
+
+    groups = _synth_groups(
+        cfg, shots_buckets, n_requests, engine.max_tenants, args.seed
+    )
+    for group in groups:
+        serve_requests(engine, group)
+
+    rollup = engine.rollup()
+    if sink is not None:
+        sink.close()
+    line = {
+        "metric": "serving_adaptation_latency_ms",
+        "value": rollup["adapt_ms_p50"],
+        "unit": "ms",
+        "adaptation_latency_ms_p50": rollup["adapt_ms_p50"],
+        "adaptation_latency_ms_p95": rollup["adapt_ms_p95"],
+        # the engine's rollup is the ONE definition of this metric — the
+        # printed line and the telemetry rollup record can never disagree
+        "tenants_per_sec": rollup["tenants_per_sec"],
+        "dispatches": rollup["dispatches"],
+        "tenants": rollup["tenants"],
+        "retraces": rollup["retraces"],
+        "warmup_seconds": round(warmup_s, 3),
+        "bucket_ladder": list(engine.buckets),
+        "shots_buckets": list(engine.shots_buckets),
+        "max_tenants_per_dispatch": engine.max_tenants,
+        "fast": bool(args.fast),
+    }
+    import jax
+
+    line["backend"] = jax.default_backend()
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

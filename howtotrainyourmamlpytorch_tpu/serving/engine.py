@@ -1,0 +1,473 @@
+"""ServingEngine: the compiled adapt-then-predict hot path.
+
+The engine owns a servable snapshot (a ``MetaState`` restored READ-ONLY
+from a training checkpoint — no experiment-dir mutation, see
+``experiment.checkpoint.load_checkpoint(readonly=True)``) and the jitted
+``core.maml.make_serve_step`` program, dispatched at a fixed set of
+static shapes:
+
+* **tenant buckets** — every dispatch is padded up to the smallest
+  ``serving_bucket_ladder`` entry >= its tenant count, with a float mask
+  zeroing pad tenants out of the aggregate metrics (per-tenant outputs
+  are independent of padding by vmap construction, tested bit-exact);
+* **shots buckets** — one compiled signature per distinct support-shot
+  count the engine is configured to serve (``shots_buckets``; default:
+  the config's ``num_samples_per_class`` only). Shots are never padded —
+  pad support samples would enter the inner-loop adaptation loss.
+
+``warmup()`` compiles (and executes once, on zeros) every
+(bucket, shots) program at startup, so the first real request pays no
+compile; when the config points at a persistent compilation cache the
+compiles warm-start from the training run's ``xla_cache``. A STRICT
+``analysis.auditor.RetraceDetector`` watches every dispatch site: after
+warmup, any new abstract signature — i.e. any mid-run retrace — raises
+instead of silently paying a 20-40s TPU compile on a live request.
+
+State donation: the serve program passes the state through as an output
+and the jit donates it (``maml.SERVE_DONATE``) — the executable aliases
+the state buffers input->output (the donation contract the auditor
+checks), the engine re-binds its reference after every dispatch, and the
+snapshot stays single-buffered in HBM like the train family's state.
+
+Telemetry: every dispatch emits a schema-v8 ``serving`` record
+(event='dispatch': tenants, bucket, shots, queue_ms, adapt_ms) through
+``telemetry.sinks.make_record`` into an optional sink; ``rollup()``
+condenses the run into an event='rollup' record (adapt_ms p50/p95,
+tenants_per_sec) — the line ``cli inspect summary`` prints jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import MAMLConfig
+
+
+@dataclass
+class TenantResult:
+    """One tenant's adapt-then-predict outcome.
+
+    ``preds`` is the (way * targets, classes) softmax over the query set
+    — the leading axis is the FLATTENED (class, target) query stream,
+    class-major, matching the eval path's prediction layout; ``loss`` /
+    ``accuracy`` are the query-set scalars, None when the request
+    shipped no query labels (predictions are label-free).
+    """
+
+    tenant_id: Optional[str]
+    preds: np.ndarray
+    loss: Optional[float]
+    accuracy: Optional[float]
+
+
+@dataclass
+class DispatchResult:
+    """One dispatch's results + the latency the telemetry records."""
+
+    results: List[TenantResult]
+    tenants: int
+    bucket: int
+    shots: int
+    queue_ms: float
+    adapt_ms: float
+    metrics: Dict[str, float]  # masked tenant-mean loss/accuracy over
+    # the LABELED tenants (0 when the dispatch carried none)
+
+
+def load_servable_snapshot(
+    cfg: MAMLConfig,
+    model_save_dir: str,
+    model_idx="latest",
+    model_name: str = "train_model",
+    enable_cache: bool = True,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Restore a training checkpoint into a servable (host) snapshot.
+
+    READ-ONLY by contract: the restore never mutates the training run's
+    directory — no ``.old`` recovery rename, no summary-CSV truncation,
+    no experiment-state rewrite (the training-owned resume path in
+    ``experiment/builder.py`` does all three; a serving process reading a
+    LIVE run's directory must do none). Returns
+    ``(MetaState, experiment_state)`` with host numpy leaves — the engine
+    places them on device.
+
+    ``enable_cache`` (default) also points this process's persistent
+    compilation cache at the training run's ``xla_cache``
+    (``resolve_serving_cache_dir`` — the one additive write serving may
+    make under the experiment dir), so a subsequent ``warmup()``
+    warm-starts from the training run's compiles instead of paying them
+    again. Pass False to leave the process's cache setting untouched.
+
+    The shape/dtype template comes from ``jax.eval_shape`` over
+    ``maml.init_state``, so loading allocates nothing beyond the restored
+    arrays themselves.
+    """
+    import jax
+
+    from ..core import maml
+    from ..experiment import checkpoint as ckpt
+
+    if enable_cache:
+        from ..experiment.system import enable_compilation_cache
+
+        cache_dir = resolve_serving_cache_dir(cfg, model_save_dir)
+        if cache_dir:
+            enable_compilation_cache(cache_dir)
+    template = jax.eval_shape(lambda: maml.init_state(cfg))
+    return ckpt.load_checkpoint(
+        model_save_dir, model_name, model_idx, template, readonly=True
+    )
+
+
+def _bucket_for(n: int, ladder: Sequence[int]) -> int:
+    for b in ladder:
+        if n <= b:
+            return b
+    raise ValueError(
+        f"{n} tenants exceed the serving bucket ladder {list(ladder)}; "
+        "the batcher must cap groups at serving_max_tenants_per_dispatch"
+    )
+
+
+class ServingEngine:
+    """Multi-tenant adapt-on-request inference over one servable snapshot.
+
+    :param cfg: fixes the task geometry (way / query targets / image
+        shape) and the serving knobs (``serving_bucket_ladder``,
+        ``serving_max_tenants_per_dispatch``).
+    :param state: the servable ``MetaState`` (host numpy or device
+        arrays) — from ``load_servable_snapshot`` or ``maml.init_state``.
+    :param shots_buckets: support-shot counts to compile programs for
+        (default: the config's ``num_samples_per_class`` only).
+    :param sink: optional telemetry sink (``telemetry.sinks.JsonlSink``
+        or anything with ``write(record)``); records are built through
+        ``make_record`` (schema v8 ``serving`` kind).
+    :param strict_retrace: raise ``RetraceError`` on any post-warmup
+        recompile (the production default); False records events only.
+    """
+
+    #: latency-sample window for the rollup percentiles (last N
+    #: dispatches) — bounds host memory on a long-lived server
+    LATENCY_WINDOW = 4096
+
+    def __init__(
+        self,
+        cfg: MAMLConfig,
+        state,
+        shots_buckets: Optional[Sequence[int]] = None,
+        sink=None,
+        strict_retrace: bool = True,
+    ):
+        import jax
+
+        from ..analysis.auditor import RetraceDetector
+        from ..core import maml
+
+        self.cfg = cfg
+        self.buckets: Tuple[int, ...] = tuple(cfg.serving_bucket_ladder)
+        self.max_tenants: int = cfg.serving_max_tenants_per_dispatch
+        self.shots_buckets: Tuple[int, ...] = tuple(
+            shots_buckets
+            if shots_buckets is not None
+            else (cfg.num_samples_per_class,)
+        )
+        if any(s < 1 for s in self.shots_buckets):
+            raise ValueError(
+                f"shots buckets must be >= 1, got {self.shots_buckets}"
+            )
+        self.sink = sink
+        # the engine OWNS its device snapshot: every dispatch donates the
+        # state and re-binds to the (aliased) returned one, so the buffers
+        # must be private — ``jnp.array(copy=True)`` (plain device_put is
+        # a no-op for an already-committed array and would donate the
+        # CALLER's buffers out from under it)
+        import jax.numpy as jnp
+
+        self._state = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), state
+        )
+        self._step = jax.jit(
+            maml.make_serve_step(cfg), donate_argnums=maml.SERVE_DONATE
+        )
+        self.retrace_detector = RetraceDetector(strict=strict_retrace)
+        # a dispatch that fails AFTER donation leaves self._state pointing
+        # at deleted buffers; the engine marks itself dead with the root
+        # cause so later requests fail fast naming it, instead of a
+        # stream of unrelated "buffer was donated/deleted" errors
+        self._dead: Optional[BaseException] = None
+        # rollup accumulators (per-dispatch samples, warmup excluded);
+        # throughput is measured over the wall-clock SPAN from the first
+        # real dispatch's start to the last one's end — summing per-
+        # dispatch queue+adapt would double-count queue time that
+        # overlaps the previous dispatch's device time under the
+        # micro-batcher. Latency samples are a BOUNDED window (the last
+        # LATENCY_WINDOW dispatches): a long-lived server must not grow
+        # host memory per dispatch, and windowed p50/p95 track current
+        # latency instead of a lifetime aggregate.
+        self._adapt_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        self._queue_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        self._tenants_served = 0
+        self._span_start: Optional[float] = None
+        self._span_end: Optional[float] = None
+
+    # -- shapes ------------------------------------------------------------
+
+    def _zeros_batch(self, bucket: int, shots: int):
+        n = self.cfg.num_classes_per_set
+        t = self.cfg.num_target_samples
+        h, w, c = self.cfg.im_shape
+        return (
+            np.zeros((bucket, n, shots, h, w, c), np.float32),
+            np.zeros((bucket, n, shots), np.int32),
+            np.zeros((bucket, n, t, h, w, c), np.float32),
+            np.zeros((bucket, n, t), np.int32),
+        )
+
+    def _validate(self, req) -> int:
+        """Check one request against the engine geometry; returns its
+        shots count."""
+        n = self.cfg.num_classes_per_set
+        t = self.cfg.num_target_samples
+        h, w, c = self.cfg.im_shape
+        sx = np.asarray(req.support_x)
+        if sx.ndim != 5 or sx.shape[0] != n or sx.shape[2:] != (h, w, c):
+            raise ValueError(
+                f"support_x must be ({n}, shots, {h}, {w}, {c}), got "
+                f"{sx.shape}"
+            )
+        shots = int(sx.shape[1])
+        if shots not in self.shots_buckets:
+            raise ValueError(
+                f"request shots={shots} not in the engine's shots buckets "
+                f"{self.shots_buckets} (shots are never padded — they "
+                "enter the adaptation loss)"
+            )
+        if tuple(np.asarray(req.support_y).shape) != (n, shots):
+            raise ValueError(
+                f"support_y must be ({n}, {shots}), got "
+                f"{np.asarray(req.support_y).shape}"
+            )
+        qx = np.asarray(req.query_x)
+        if qx.shape != (n, t, h, w, c):
+            raise ValueError(
+                f"query_x must be ({n}, {t}, {h}, {w}, {c}), got {qx.shape}"
+            )
+        if req.query_y is not None and tuple(
+            np.asarray(req.query_y).shape
+        ) != (n, t):
+            raise ValueError(
+                f"query_y must be ({n}, {t}) or None, got "
+                f"{np.asarray(req.query_y).shape}"
+            )
+        return shots
+
+    # -- compile management ------------------------------------------------
+
+    def _site(self, bucket: int, shots: int) -> str:
+        return f"serve_step[b={bucket},s={shots}]"
+
+    def warmup(self) -> float:
+        """Compile (and run once, on zeros) every (bucket, shots) program.
+
+        Returns the wall seconds spent — the whole compile bill of the
+        engine: after this, steady-state traffic of ANY mix of bucket
+        sizes and configured shots dispatches with zero retraces (the
+        strict detector enforces it). With a persistent compilation cache
+        enabled the compiles warm-start from disk.
+        """
+        start = time.perf_counter()
+        for shots in self.shots_buckets:
+            for bucket in self.buckets:
+                x_s, y_s, x_t, y_t = self._zeros_batch(bucket, shots)
+                valid = np.zeros(bucket, np.float32)
+                self._dispatch(bucket, shots, x_s, y_s, x_t, y_t, valid)
+        return time.perf_counter() - start
+
+    def _dispatch(self, bucket, shots, x_s, y_s, x_t, y_t, valid):
+        """One device dispatch; returns (out, adapt_ms). ``adapt_ms`` is
+        enqueue-to-host-fetch: it includes the H2D upload and the result
+        readback — the latency a caller actually observes.
+
+        A failure in here (device error, OOM, interrupt mid-readback) is
+        TERMINAL for the engine: the dispatch may already have consumed
+        the donated state buffers, so the engine marks itself dead with
+        the root cause and every later call raises it — never a stream
+        of unrelated donated-buffer errors masking the real failure.
+        """
+        if self._dead is not None:
+            raise RuntimeError(
+                "ServingEngine is dead: a previous dispatch failed after "
+                "the state was donated (root cause chained below); build "
+                "a fresh engine from the snapshot"
+            ) from self._dead
+        self.retrace_detector.observe(
+            self._site(bucket, shots), (self._state, x_s, y_s, x_t, y_t, valid)
+        )
+        start = time.perf_counter()
+        try:
+            new_state, out = self._step(
+                self._state, x_s, y_s, x_t, y_t, valid
+            )
+            # host-fetch every output the caller reads: the one sync that
+            # provably blocks on every backend (see bench.py's sync note)
+            out = {
+                "preds": np.asarray(out["preds"]),
+                "loss": np.asarray(out["loss"]),
+                "accuracy": np.asarray(out["accuracy"]),
+                "metrics": {
+                    k: float(np.asarray(v))
+                    for k, v in out["metrics"].items()
+                },
+            }
+        except BaseException as e:
+            self._dead = e
+            raise
+        adapt_ms = (time.perf_counter() - start) * 1e3
+        # re-bind: the old state buffers were donated to (and alias) the
+        # returned state — the previous reference is dead
+        self._state = new_state
+        return out, adapt_ms
+
+    # -- serving -----------------------------------------------------------
+
+    def serve_group(self, requests: Sequence[Any],
+                    queue_ms: float = 0.0) -> DispatchResult:
+        """Serve one group of same-shots requests as ONE padded dispatch.
+
+        The group must fit ``serving_max_tenants_per_dispatch`` (the
+        batcher's job); pad tenants up to the bucket are zeros, masked
+        out of the aggregate metrics and — by vmap independence —
+        incapable of touching real tenants' outputs.
+        """
+        if not requests:
+            raise ValueError("serve_group needs at least one request")
+        if len(requests) > self.max_tenants:
+            raise ValueError(
+                f"{len(requests)} requests exceed "
+                f"serving_max_tenants_per_dispatch={self.max_tenants}"
+            )
+        shots_set = {self._validate(r) for r in requests}
+        if len(shots_set) != 1:
+            raise ValueError(
+                f"one dispatch must carry one shots bucket, got {shots_set}"
+            )
+        shots = shots_set.pop()
+        n_real = len(requests)
+        bucket = _bucket_for(n_real, self.buckets)
+        x_s, y_s, x_t, y_t = self._zeros_batch(bucket, shots)
+        valid = np.zeros(bucket, np.float32)
+        labeled = np.zeros(n_real, bool)
+        for i, req in enumerate(requests):
+            x_s[i] = np.asarray(req.support_x, np.float32)
+            y_s[i] = np.asarray(req.support_y, np.int32)
+            x_t[i] = np.asarray(req.query_x, np.float32)
+            if req.query_y is not None:
+                y_t[i] = np.asarray(req.query_y, np.int32)
+                labeled[i] = True
+                # the metric mask admits LABELED tenants only: a
+                # label-free tenant's y_t slot is fabricated zeros, and
+                # scoring it would poison the aggregate (its predictions
+                # don't read labels and are unaffected)
+                valid[i] = 1.0
+        if self._span_start is None:
+            self._span_start = time.perf_counter()
+        out, adapt_ms = self._dispatch(
+            bucket, shots, x_s, y_s, x_t, y_t, valid
+        )
+        self._span_end = time.perf_counter()
+        results = [
+            TenantResult(
+                tenant_id=getattr(req, "tenant_id", None),
+                preds=out["preds"][i],
+                loss=float(out["loss"][i]) if labeled[i] else None,
+                accuracy=float(out["accuracy"][i]) if labeled[i] else None,
+            )
+            for i, req in enumerate(requests)
+        ]
+        self._adapt_ms.append(adapt_ms)
+        self._queue_ms.append(float(queue_ms))
+        self._tenants_served += n_real
+        self._record(
+            event="dispatch", tenants=n_real, bucket=bucket, shots=shots,
+            queue_ms=round(float(queue_ms), 3), adapt_ms=round(adapt_ms, 3),
+        )
+        return DispatchResult(
+            results=results, tenants=n_real, bucket=bucket, shots=shots,
+            queue_ms=float(queue_ms), adapt_ms=adapt_ms,
+            metrics=out["metrics"],
+        )
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _record(self, **fields) -> None:
+        if self.sink is None:
+            return
+        from ..telemetry.sinks import make_record
+
+        self.sink.write(make_record("serving", **fields))
+
+    def rollup(self) -> Dict[str, Any]:
+        """Latency/throughput rollup; emits the event='rollup' telemetry
+        record when a sink is attached. Percentiles cover the last
+        ``LATENCY_WINDOW`` (non-warmup) dispatches (current latency, not
+        a lifetime aggregate); ``tenants_per_sec`` is lifetime tenants
+        over the wall-clock span from the first dispatch's start to the
+        last one's end — the closed-loop number, and the ONE definition
+        of this metric (serve-bench and bench.py report it verbatim); an
+        open-loop server's throughput is additionally bounded by arrival
+        rate."""
+        adapt = np.asarray(self._adapt_ms, np.float64)
+        queue = np.asarray(self._queue_ms, np.float64)
+        span_s = (
+            self._span_end - self._span_start
+            if self._span_start is not None and self._span_end is not None
+            else 0.0
+        )
+        out: Dict[str, Any] = {
+            "dispatches": int(adapt.size),
+            "tenants": int(self._tenants_served),
+            "retraces": int(self.retrace_detector.retrace_count),
+            "adapt_ms_p50": (
+                round(float(np.percentile(adapt, 50)), 3) if adapt.size
+                else None
+            ),
+            "adapt_ms_p95": (
+                round(float(np.percentile(adapt, 95)), 3) if adapt.size
+                else None
+            ),
+            "queue_ms_p50": (
+                round(float(np.percentile(queue, 50)), 3) if queue.size
+                else None
+            ),
+            "tenants_per_sec": (
+                round(self._tenants_served / span_s, 3)
+                if span_s > 0
+                else None
+            ),
+        }
+        self._record(event="rollup", **out)
+        return out
+
+
+def resolve_serving_cache_dir(cfg: MAMLConfig,
+                              model_save_dir: str) -> Optional[str]:
+    """The persistent-compilation-cache directory a serving process should
+    warm-start from: an explicit ``compilation_cache_dir`` wins; 'auto'
+    resolves to the training experiment's ``xla_cache`` SIBLING of the
+    checkpoint directory (the same resolution the experiment builder
+    makes); '' disables. The cache is content-addressed and additive —
+    the one write a serving process may make under the experiment dir.
+    """
+    if cfg.compilation_cache_dir == "":
+        return None
+    if cfg.compilation_cache_dir != "auto":
+        return cfg.compilation_cache_dir
+    return os.path.join(
+        os.path.dirname(os.path.abspath(model_save_dir)), "xla_cache"
+    )

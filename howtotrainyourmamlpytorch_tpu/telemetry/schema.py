@@ -69,6 +69,15 @@ Record kinds:
   processes resumed on ``new_process_count``, with the global
   ``episode_cursor`` re-entry point) — so a pod-scale preemption or a
   topology-changing resume documents itself in the run's own log;
+* ``serving``        — the adapt-on-request serving engine (serving/,
+  schema v8): ``event`` names the record shape — ``dispatch`` (one
+  multi-tenant serving dispatch: real ``tenants``, the padded
+  ``bucket`` and ``shots`` point it rode, host ``queue_ms`` in the
+  micro-batcher and end-to-end ``adapt_ms`` device latency) or
+  ``rollup`` (the run condensed: dispatch/tenant counts,
+  ``adapt_ms_p50`` / ``adapt_ms_p95``, ``tenants_per_sec``, and the
+  strict retrace count — 0 in any healthy run). The ``serving:`` line
+  of ``cli inspect summary`` renders these jax-free;
 * ``analysis``       — the build-time program audit ran
   (``analysis_level != 'off'``): how many programs were audited (incl.
   the SPMD family on multi-device builds), how many contract violations
@@ -127,6 +136,12 @@ Version history / migration notes:
   (``tests/fixtures/telemetry_v6_schema.jsonl`` pins a v6-era log) and
   the forward-compat rules carry over (the future-schema fixture is
   re-pinned at v8-unknown).
+* **v8** — adds the ``serving`` record kind (the adapt-on-request
+  serving engine: per-dispatch tenants/bucket/queue/adapt latency and
+  the p50/p95 + tenants-per-sec rollup). Pure addition: every v1..v7
+  record validates unchanged (``tests/fixtures/telemetry_v7_schema.jsonl``
+  pins a v7-era log) and the forward-compat rules carry over (the
+  future-schema fixture is re-pinned at v9-unknown).
 """
 
 from __future__ import annotations
@@ -134,7 +149,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -159,6 +174,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "retrace": ("iter", "site", "signature"),
     "analysis": ("programs", "violations"),
     "elastic": ("event",),
+    "serving": ("event",),
 }
 
 

@@ -141,6 +141,69 @@ def _elastic_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _percentile(values: List[float], q: float) -> float:
+    """Linear-interpolation percentile (numpy's default), pure stdlib —
+    this module must run on a laptop with nothing but the repo."""
+    vals = sorted(values)
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * q / 100.0
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+
+def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Condense the ``serving`` records (schema v8): dispatch/tenant
+    counts and adapt-latency p50/p95 recomputed from the per-dispatch
+    records, plus the LAST rollup record's tenants_per_sec / retraces.
+    None when the run has no serving records at all (every pre-v8 log),
+    so the summary line simply doesn't render — old logs never crash."""
+    sv = [r for r in records if r.get("kind") == "serving"]
+    if not sv:
+        return None
+
+    def _finite(key: str) -> List[float]:
+        return [
+            r[key] for r in sv
+            if r.get("event") == "dispatch"
+            and isinstance(r.get(key), (int, float))
+            and not isinstance(r.get(key), bool)
+            and math.isfinite(r[key])
+        ]
+
+    adapt = _finite("adapt_ms")
+    queue = _finite("queue_ms")
+    tenants = [
+        int(r["tenants"]) for r in sv
+        if r.get("event") == "dispatch"
+        and isinstance(r.get("tenants"), int)
+        and not isinstance(r.get("tenants"), bool)
+    ]
+    rollup = next(
+        (r for r in reversed(sv) if r.get("event") == "rollup"), None
+    )
+    out: Dict[str, Any] = {
+        "dispatches": sum(1 for r in sv if r.get("event") == "dispatch"),
+        "tenants": sum(tenants),
+        "tenants_per_dispatch_mean": (
+            round(sum(tenants) / len(tenants), 3) if tenants else None
+        ),
+        "adapt_ms_p50": (
+            round(_percentile(adapt, 50), 3) if adapt else None
+        ),
+        "adapt_ms_p95": (
+            round(_percentile(adapt, 95), 3) if adapt else None
+        ),
+        "queue_ms_mean": (
+            round(sum(queue) / len(queue), 3) if queue else None
+        ),
+        "tenants_per_sec": (rollup or {}).get("tenants_per_sec"),
+        "retraces": (rollup or {}).get("retraces"),
+    }
+    return out
+
+
 def _dispatch_stats(records: List[dict]) -> Optional[Dict[str, float]]:
     """Step-time stats averaged over the run's ``dispatch`` records (the
     per-epoch StepTimer summaries: mean/p50/p95/p99 dispatch latency)."""
@@ -272,6 +335,9 @@ def cmd_summary(args) -> int:
         # elastic multi-host coordination (schema v6): drain protocol
         # progress + the last topology-change resume marker
         "elastic": _elastic_summary(records),
+        # adapt-on-request serving (schema v8): dispatch/tenant counts,
+        # adapt-latency percentiles, throughput, strict-retrace count
+        "serving": _serving_summary(records),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -369,6 +435,27 @@ def cmd_summary(args) -> int:
                 f"{lr.get('episode_cursor')})"
             )
         lines.append(line)
+    sv = payload["serving"]
+    if sv:
+        parts = [
+            f"{sv['dispatches']} dispatch(es), {sv['tenants']} tenant(s)"
+        ]
+        if sv.get("tenants_per_dispatch_mean") is not None:
+            parts.append(
+                f"{sv['tenants_per_dispatch_mean']:.2f} tenants/dispatch"
+            )
+        if sv.get("adapt_ms_p50") is not None:
+            line = f"adapt p50 {sv['adapt_ms_p50']:.2f}ms"
+            if sv.get("adapt_ms_p95") is not None:
+                line += f" p95 {sv['adapt_ms_p95']:.2f}ms"
+            parts.append(line)
+        if sv.get("queue_ms_mean") is not None:
+            parts.append(f"queue {sv['queue_ms_mean']:.2f}ms")
+        if sv.get("tenants_per_sec") is not None:
+            parts.append(f"{sv['tenants_per_sec']:.1f} tenants/s")
+        if sv.get("retraces"):
+            parts.append(f"{sv['retraces']} RETRACE(S)")
+        lines.append("  serving: " + ", ".join(parts))
     audit = payload["audit"]
     if audit:
         line = (

@@ -137,7 +137,8 @@ def micro_cfg() -> MAMLConfig:
 @pytest.fixture(scope="session")
 def audit_reports(micro_cfg):
     """One audit of the canonical program family (4 donating train-step
-    jits + fused eval multi-step + index expander), compiled ONCE per test
+    jits + fused eval multi-step + index expander + serving step), compiled
+    ONCE per test
     session and shared by the contract tests (test_analysis.py) and the
     donation-contract tests (test_donation.py)."""
     from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib

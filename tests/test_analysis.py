@@ -53,6 +53,7 @@ def test_family_has_expected_programs(audit_reports):
         "train_multi_step_indexed[so=1,k=2]",
         "eval_multi_step[k=2]",
         "index_expander",
+        "serve_step[b=2]",
     }
 
 
@@ -274,11 +275,11 @@ def test_census_compare_skipped_for_foreign_baseline(micro_cfg):
 
 
 def test_pinned_repo_baseline_loads():
-    """CONTRACTS.json at the repo root parses and covers the six canonical
+    """CONTRACTS.json at the repo root parses and covers the seven canonical
     programs (the re-pin workflow keeps it in lockstep with the family)."""
     baseline = contracts_lib.load_baseline()
     assert baseline is not None, "CONTRACTS.json missing at the repo root"
-    assert len(baseline["programs"]) >= 6
+    assert len(baseline["programs"]) >= 7
     for key in baseline["programs"]:
         assert "@" in key
 
@@ -620,7 +621,7 @@ def test_cli_audit_end_to_end(tmp_path, micro_cfg, capsys):
     ])
     assert rc == 0
     pinned = contracts_lib.load_baseline(str(contracts_path))
-    assert pinned is not None and len(pinned["programs"]) == 6
+    assert pinned is not None and len(pinned["programs"]) == 7
     rc = audit_cli.main([
         "--config", str(cfg_path), "--contracts", str(contracts_path),
         "--json",

@@ -90,6 +90,15 @@ def test_bench_prints_parsable_json_line():
     assert ho["off_ms_per_step"] > 0 and ho["monitor_ms_per_step"] > 0
     assert ho["timed_steps"] >= 1
     assert "overhead_pct" in ho
+    # adapt-on-request serving: latency percentiles + throughput under
+    # the strict zero-retrace gate (ROADMAP item 1)
+    sv = rec["serving"]
+    assert sv["adaptation_latency_ms_p50"] > 0
+    assert sv["adaptation_latency_ms_p95"] >= sv["adaptation_latency_ms_p50"]
+    assert sv["tenants_per_sec"] > 0
+    assert sv["retraces"] == 0
+    assert sv["dispatches"] >= 1 and sv["tenants"] >= sv["dispatches"]
+    assert sv["bucket_ladder"] == [1, 2]  # the reduced-mode ladder
     assert rec["n_chips"] >= 1
     assert rec["dtype"] in ("float32", "bfloat16")
     # the step lowering is self-describing: conv impl + channel padding

@@ -122,12 +122,34 @@ def test_all_four_train_jits_honor_donation_contract(audit_reports, micro_cfg):
 def test_eval_programs_do_not_donate(audit_reports):
     """Eval deliberately donates nothing (no replacement state, batches
     unaliasable — see the contract note in core/maml.py): the audited
-    eval/expander programs carry no donation spec."""
+    eval/expander programs carry no donation spec. The SERVING step is
+    the exception that proves the rule: it passes the state THROUGH as
+    an output precisely so it CAN donate (maml.SERVE_DONATE) — checked
+    separately below."""
     for r in audit_reports:
         if not r.program.startswith(
-            ("train_step", "train_multi_step")
+            ("train_step", "train_multi_step", "serve_step")
         ):
             assert r.donation is None, r.program
+
+
+def test_serve_step_donates_passthrough_state(audit_reports, micro_cfg):
+    """The serving program's donation contract: the passthrough state is
+    donated and the executable aliases it whole — the servable snapshot
+    stays single-buffered in HBM across request dispatches exactly like
+    the train state (serving/engine.py re-binds per dispatch)."""
+    from howtotrainyourmamlpytorch_tpu.analysis import auditor as audit_lib
+
+    state_bytes = audit_lib.tree_byte_size(
+        audit_lib._state_avals(micro_cfg)
+    )
+    serve = [r for r in audit_reports if r.program.startswith("serve_step")]
+    assert len(serve) == 1
+    r = serve[0]
+    assert [v for v in r.violations if v.contract == "donation"] == []
+    assert r.donation is not None
+    assert r.donation["donate_argnums"] == list(maml.SERVE_DONATE)
+    assert r.donation["alias_size_bytes"] >= state_bytes
 
 
 def test_system_repeated_dispatches_and_eval(tiny_cfg):

@@ -4,7 +4,7 @@ Same three-layer structure as test_analysis.py:
 
 * the canonical program family audits CLEAN under a real 2x4 hybrid
   (data, task) mesh — sharding, collective-census, HBM-budget and
-  roofline contracts hold on all six programs (the session-scoped
+  roofline contracts hold on all seven programs (the session-scoped
   ``spmd_audit_reports`` fixture compiles the family once);
 * mutation tests — deliberately break ONE contract per throwaway program
   (batch sharding dropped, a replicated-store gather forced into the
@@ -59,6 +59,7 @@ def test_spmd_family_has_expected_programs(spmd_audit_reports):
         "train_multi_step_indexed[so=1,k=2]",
         "eval_multi_step[k=2]",
         "index_expander",
+        "serve_step[b=8]",
     }
     assert all(r.mesh_spec == "2x4" for r in spmd_audit_reports)
 
@@ -521,7 +522,7 @@ def test_cli_audit_mesh_end_to_end(tmp_path, spmd_micro_cfg, capsys):
     ])
     assert rc == 0
     pinned = contracts_lib.load_baseline(str(contracts_path))
-    assert pinned is not None and len(pinned["programs"]) == 6
+    assert pinned is not None and len(pinned["programs"]) == 7
     assert all(key.endswith("@2x4") for key in pinned["programs"])
     capsys.readouterr()
     rc = audit_cli.main([
@@ -548,7 +549,7 @@ def test_cli_audit_mesh_end_to_end(tmp_path, spmd_micro_cfg, capsys):
 
 def test_pinned_repo_baseline_has_mesh_entries():
     """CONTRACTS.json at the repo root carries the 1x8 mesh-keyed SPMD
-    entries next to the six single-device ones (the `cli audit --mesh
+    entries next to the seven single-device ones (the `cli audit --mesh
     1x8` CI gate compares against them)."""
     baseline = contracts_lib.load_baseline()
     assert baseline is not None, "CONTRACTS.json missing at the repo root"
@@ -556,8 +557,8 @@ def test_pinned_repo_baseline_has_mesh_entries():
     plain_keys = [k for k in baseline["programs"] if "@" not in k.replace(
         "@cpu", "", 1
     )]
-    assert len(mesh_keys) == 6
-    assert len(plain_keys) == 6
+    assert len(mesh_keys) == 7
+    assert len(plain_keys) == 7
     train_key = contracts_lib.spmd_census_key(
         "train_step[so=1]", "cpu", "1x8"
     )

@@ -709,6 +709,37 @@ def test_v7_dispatch_overlap_fields_validate():
     ))
 
 
+def test_validate_file_accepts_v7_era_fixture():
+    """The pinned v7-era log (written before the v8 `serving` kind
+    existed) validates unchanged under the v8 validator — the backward
+    half of the version contract: v8 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v7_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 7
+
+
+def test_v8_serving_record_kind_validates():
+    """The schema v8 addition: `serving` records (the adapt-on-request
+    engine) — per-dispatch latency records and the p50/p95 rollup both
+    round-trip through make_record and validate."""
+    rec = tel.make_record(
+        "serving", event="dispatch", tenants=3, bucket=4, shots=1,
+        queue_ms=0.8, adapt_ms=4.2,
+    )
+    tel.validate_record(rec)
+    assert rec["schema"] == tel.SCHEMA_VERSION and rec["kind"] == "serving"
+    tel.validate_record(tel.make_record(
+        "serving", event="rollup", dispatches=12, tenants=31,
+        adapt_ms_p50=4.1, adapt_ms_p95=9.9, tenants_per_sec=120.5,
+        retraces=0,
+    ))
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "serving",
+        })
+
+
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------
 
 
