@@ -16,11 +16,13 @@ Two entry points:
   train-step jits (plain / multi / indexed / multi-indexed, the same
   factories ``experiment/system.py`` jits with ``maml.TRAIN_DONATE``),
   the fused eval multi-step, the device-pipeline index expander, and the
-  multi-tenant serving step (``maml.make_serve_step``, jitted with
-  ``maml.SERVE_DONATE`` exactly like ``serving/engine.py`` — its
-  donation contract is the state passthrough alias). Driven by ``cli
-  audit``, the builder's build-time audit (``analysis_level != 'off'``)
-  and the contract tests.
+  serving family (jitted with ``maml.SERVE_DONATE`` /
+  ``maml.PREDICT_DONATE`` exactly like ``serving/engine.py`` — the
+  donation contract is the state passthrough alias): the f32 and uint8
+  multi-tenant serve steps plus the cache-hit predict-only step, whose
+  pinned census is the machine-checked proof it carries NO inner-loop
+  gradient ops. Driven by ``cli audit``, the builder's build-time audit
+  (``analysis_level != 'off'``) and the contract tests.
 * ``RetraceDetector`` — the runtime half: hashes the abstract signature
   (treedef + leaf shapes/dtypes) of every dispatch at its site; a second
   distinct signature at one site is a mid-run retrace (a new 20-40s TPU
@@ -321,6 +323,27 @@ def _state_avals(cfg: MAMLConfig):
     return jax.eval_shape(lambda: maml.init_state(cfg))
 
 
+def _batch_avals_uint8(cfg: MAMLConfig):
+    """The uint8-ingest serve batch: raw pixel dtype, same geometry."""
+    x_s, y_s, x_t, y_t = _batch_avals(cfg)
+    return (
+        _sds(x_s.shape, jnp.uint8), y_s, _sds(x_t.shape, jnp.uint8), y_t
+    )
+
+
+def _fast_avals(cfg: MAMLConfig, bucket: int):
+    """Per-tenant adapted fast weights as (bucket, ...) ShapeDtypeStructs
+    (the predict-only program's cached-params argument)."""
+    from ..core import partition
+
+    state = _state_avals(cfg)
+    adapted, _ = partition.split_inner(cfg, state.net)
+    return {
+        k: _sds((bucket,) + tuple(v.shape), v.dtype)
+        for k, v in adapted.items()
+    }
+
+
 def audit_system_programs(
     cfg: MAMLConfig,
     auditor: Optional[ProgramAuditor] = None,
@@ -333,11 +356,12 @@ def audit_system_programs(
     Returns one ``AuditReport`` per program: the four train-step jits
     (each built with ``maml.TRAIN_DONATE`` exactly like
     ``experiment/system.py``), the fused eval multi-step, the
-    device-pipeline index expander, and the multi-tenant serving step
-    (built with ``maml.SERVE_DONATE`` exactly like ``serving/engine.py``;
-    audited at the config's batch_size as its tenant bucket). ``k`` is
-    the fused-dispatch chunk used for the multi variants; ``programs``
-    filters by name.
+    device-pipeline index expander, and the serving family — the f32 and
+    uint8 multi-tenant serve steps plus the cache-hit predict-only step
+    (built with ``maml.SERVE_DONATE`` / ``maml.PREDICT_DONATE`` exactly
+    like ``serving/engine.py``; audited at the config's batch_size as
+    their tenant bucket). ``k`` is the fused-dispatch chunk used for the
+    multi variants; ``programs`` filters by name.
     """
     auditor = auditor or ProgramAuditor(cfg)
     so = cfg.second_order if second_order is None else bool(second_order)
@@ -398,6 +422,26 @@ def audit_system_programs(
                     donate_argnums=maml.SERVE_DONATE),
             (state, *batch, _sds((cfg.batch_size,), jnp.float32)),
             maml.SERVE_DONATE,
+        ),
+        (
+            f"serve_step_uint8[b={cfg.batch_size}]",
+            jax.jit(maml.make_serve_step(cfg, ingest="uint8"),
+                    donate_argnums=maml.SERVE_DONATE),
+            (state, *_batch_avals_uint8(cfg),
+             _sds((cfg.batch_size,), jnp.float32)),
+            maml.SERVE_DONATE,
+        ),
+        (
+            f"predict_step[b={cfg.batch_size}]",
+            jax.jit(maml.make_predict_step(cfg),
+                    donate_argnums=maml.PREDICT_DONATE),
+            (state, _fast_avals(cfg, cfg.batch_size),
+             _sds((cfg.batch_size, cfg.num_classes_per_set,
+                   cfg.num_target_samples, *cfg.im_shape), jnp.float32),
+             _sds((cfg.batch_size, cfg.num_classes_per_set,
+                   cfg.num_target_samples), jnp.int32),
+             _sds((cfg.batch_size,), jnp.float32)),
+            maml.PREDICT_DONATE,
         ),
     ]
     reports = []

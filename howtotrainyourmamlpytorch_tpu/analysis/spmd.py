@@ -7,7 +7,7 @@ decide whether a pod reservation survives its first hour: does
 store?  Are the batch arguments actually sharded over ``(data, task)`` or
 is every device redundantly computing the global batch?  Will this config
 OOM per-device before the first checkpoint?  This module compiles the
-canonical seven-program family **under a real mesh** (8 fake CPU devices
+canonical nine-program family **under a real mesh** (8 fake CPU devices
 via ``--xla_force_host_platform_device_count`` in tests/CI, real chips on
 hardware) and verifies, per ``program@backend@mesh`` key pinned in
 ``CONTRACTS.json``:
@@ -53,6 +53,7 @@ from ..ops import device_pipeline
 from ..parallel import distributed, mesh as mesh_lib
 from . import contracts as C
 from . import roofline as R
+from . import auditor as audit_lib
 from .auditor import _batch_avals, _index_avals, _state_avals, tree_byte_size
 
 #: expected-sharding tags for one top-level argument of an audited program
@@ -386,7 +387,7 @@ def audit_spmd_programs(
     k: int = 2,
     programs: Optional[Sequence[str]] = None,
 ) -> List[C.SpmdAuditReport]:
-    """Audit the canonical seven-program family under ``mesh`` (default: a
+    """Audit the canonical nine-program family under ``mesh`` (default: a
     1xN hybrid mesh over every visible device). The batch size is rounded
     up to the mesh size when it does not divide it — the audit needs a
     shardable batch, and the census keys carry the mesh so rounded and
@@ -494,6 +495,44 @@ def audit_spmd_programs(
             # the tenant axis BY DESIGN; the passthrough state keeps its
             # replicated input sharding
             maml.SERVE_DONATE, False, 0,
+        ),
+        (
+            f"serve_step_uint8[b={cfg.batch_size}]",
+            jax.jit(maml.make_serve_step(cfg, ingest="uint8"),
+                    donate_argnums=maml.SERVE_DONATE),
+            (state,
+             *(_sharded(b, mesh, BATCH0)
+               for b in audit_lib._batch_avals_uint8(cfg)),
+             _sharded(jax.ShapeDtypeStruct((cfg.batch_size,), jnp.float32),
+                      mesh, BATCH0)),
+            (rp, b0, b0, b0, b0, b0),
+            # same profile as the f32 serve step: the on-device LUT
+            # decode is elementwise per tenant and introduces no
+            # collectives
+            maml.SERVE_DONATE, False, 0,
+        ),
+        (
+            f"predict_step[b={cfg.batch_size}]",
+            jax.jit(maml.make_predict_step(cfg),
+                    donate_argnums=maml.PREDICT_DONATE),
+            (state,
+             jax.tree_util.tree_map(
+                 lambda s: _sharded(s, mesh, BATCH0),
+                 audit_lib._fast_avals(cfg, cfg.batch_size),
+             ),
+             _sharded(jax.ShapeDtypeStruct(
+                 (cfg.batch_size, cfg.num_classes_per_set,
+                  cfg.num_target_samples, *cfg.im_shape), jnp.float32),
+                 mesh, BATCH0),
+             _sharded(jax.ShapeDtypeStruct(
+                 (cfg.batch_size, cfg.num_classes_per_set,
+                  cfg.num_target_samples), jnp.int32), mesh, BATCH0),
+             _sharded(jax.ShapeDtypeStruct((cfg.batch_size,), jnp.float32),
+                      mesh, BATCH0)),
+            (rp, b0, b0, b0, b0),
+            # cached fast weights ride the TENANT axis (each tenant its
+            # own adapted clone) — batch-sharded like the pixel inputs
+            maml.PREDICT_DONATE, False, 0,
         ),
     ]
     reports = []

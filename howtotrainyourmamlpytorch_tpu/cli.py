@@ -35,13 +35,24 @@ The ``serve-bench`` subcommand (serving/bench.py — needs jax) is the
 closed-loop load generator for the adapt-on-request serving engine: it
 drives mixed-bucket synthetic traffic through a ``ServingEngine`` under a
 strict retrace gate and prints one JSON line with adaptation-latency
-p50/p95 and tenants/sec (optionally writing schema-v8 ``serving``
-telemetry records with ``--telemetry PATH``):
+p50/p95, tenants/sec, per-dispatch H2D bytes and cache hit rate
+(optionally writing schema-v9 ``serving`` telemetry records with
+``--telemetry PATH``; ``--ingest {f32,uint8,index}`` selects the ingest
+tier, ``--repeat-tenant-fraction`` mixes adapted-params-cache hits in,
+``--export-dir`` warms from AOT artifacts). The ``serve-export``
+subcommand (serving/export.py — needs jax) writes those artifacts: the
+warmed (bucket x shots) program ladder serialized to a versioned dir
+keyed by device-kind/dtype/config-fingerprint, which a later engine
+start deserializes with zero XLA compilations:
 
     python -m howtotrainyourmamlpytorch_tpu.cli serve-bench --fast
     python -m howtotrainyourmamlpytorch_tpu.cli serve-bench \
         --config experiment_config/exp.json \
         --checkpoint experiment/saved_models --telemetry /tmp/serving.jsonl
+    python -m howtotrainyourmamlpytorch_tpu.cli serve-export --fast \
+        --out /tmp/serve_artifacts
+    python -m howtotrainyourmamlpytorch_tpu.cli serve-bench --fast \
+        --export-dir /tmp/serve_artifacts
 
 The ``tune`` subcommand (analysis/autotune.py) is the roofline-driven
 step autotuner: it sweeps (conv_impl x pad_channels x remat_policy x
@@ -140,6 +151,14 @@ def main(argv=None):
         from .serving.bench import main as serve_bench_main
 
         raise SystemExit(serve_bench_main(args[1:]))
+    if args and args[0] == "serve-export":
+        # AOT-export the serving program ladder to a versioned artifact
+        # dir (serving/export.py — compiles programs: needs jax); a
+        # later ServingEngine.warmup() deserializes it with ZERO XLA
+        # compilations instead of paying the multi-second compile bill
+        from .serving.export import main as serve_export_main
+
+        raise SystemExit(serve_export_main(args[1:]))
     if args and args[0] == "tune":
         # roofline-driven step autotuner: jax-free in THIS process (every
         # sweep point is a bench.py subprocess), so dispatch before the

@@ -363,6 +363,32 @@ class MAMLConfig:
     # cap on the tenants one serving dispatch carries; must not exceed
     # the ladder's top bucket (every full group must fit a bucket)
     serving_max_tenants_per_dispatch: int = 8
+    # serving ingest tier (serving/engine.py) — what crosses H2D per
+    # dispatch:
+    # 'f32'   — host-assembled float32 NHWC pixels (the classic path);
+    # 'uint8' — raw uint8 pixels, decoded on device through the
+    #           device-pipeline LUT (bit-exact with the host decode by
+    #           construction, ~4x less H2D per dispatch);
+    # 'index' — int32 store-row indices only; the engine must be handed a
+    #           registered uint8 FlatStore (resident in HBM, uploaded
+    #           once) and per-dispatch H2D drops to the index tensors
+    #           (<1KB). Labels never cross H2D (slot iota, the training
+    #           index-path convention).
+    serving_ingest: str = "f32"  # 'f32' | 'uint8' | 'index'
+    # adapted-params cache (serving/engine.py): LRU capacity in tenants.
+    # >0 stores each tenant's post-adaptation fast weights keyed by its
+    # support-set fingerprint (content hash + shots + snapshot id);
+    # repeat tenants skip the inner loop entirely and ride the cheap
+    # predict-only program (forward GEMMs only), bit-exact with full
+    # re-adaptation at the same tenant width. 0 (default) disables the
+    # cache and keeps the engine's program family unchanged.
+    serving_adapted_cache_size: int = 0
+    # AOT export artifacts (serving/export.py): when set, the engine's
+    # warmup loads serialized (bucket x shots) executables from this
+    # directory (keyed by device-kind/dtype/config-fingerprint) instead
+    # of compiling, falling back to compile-then-save on any mismatch;
+    # `cli serve-export` writes the artifacts ahead of time. '' disables.
+    serving_export_dir: str = ""
 
     # --- static analysis (analysis/) --------------------------------------
     # program-contract audits + runtime retrace detection:
@@ -609,6 +635,37 @@ class MAMLConfig:
                 f"[1, max(serving_bucket_ladder)={ladder[-1]}] so every "
                 "full dispatch group fits a bucket, got "
                 f"{self.serving_max_tenants_per_dispatch!r}"
+            )
+        if self.serving_ingest not in ("f32", "uint8", "index"):
+            raise ValueError(
+                f"serving_ingest must be 'f32', 'uint8' or 'index', got "
+                f"{self.serving_ingest!r}"
+            )
+        if self.serving_ingest != "f32" and "cifar" in self.dataset_name:
+            # same exclusion (and the same reason) as the training-side
+            # non-host placements: CIFAR's per-image RNG augmentation
+            # cannot be replayed on device
+            raise ValueError(
+                f"serving_ingest={self.serving_ingest!r} is not supported "
+                f"for dataset {self.dataset_name!r}: the on-device decode "
+                "cannot replay CIFAR's per-image RNG crop/flip; use "
+                "serving_ingest='f32' for CIFAR configs"
+            )
+        if isinstance(
+            self.serving_adapted_cache_size, float
+        ) and self.serving_adapted_cache_size.is_integer():
+            self.serving_adapted_cache_size = int(
+                self.serving_adapted_cache_size
+            )
+        if not (
+            isinstance(self.serving_adapted_cache_size, int)
+            and not isinstance(self.serving_adapted_cache_size, bool)
+            and self.serving_adapted_cache_size >= 0
+        ):
+            raise ValueError(
+                "serving_adapted_cache_size must be an int >= 0 (0 "
+                "disables the adapted-params cache), got "
+                f"{self.serving_adapted_cache_size!r}"
             )
         if self.analysis_level not in ("off", "warn", "strict"):
             raise ValueError(

@@ -147,7 +147,8 @@ def make_optimizer(cfg: MAMLConfig, params: Dict[str, jnp.ndarray]):
 
 
 def _task_learner(
-    cfg: MAMLConfig, num_steps: int, second_order: bool, collect: bool = False
+    cfg: MAMLConfig, num_steps: int, second_order: bool, collect: bool = False,
+    return_adapted: bool = False,
 ):
     """Per-task bi-level loss: the reference's per-task body
     (few_shot_learning_system.py:197-252) as a pure function.
@@ -160,6 +161,12 @@ def _task_learner(
     value rides along via value_and_grad), all under ``stop_gradient`` so
     the meta-gradient graph is untouched; ``collect=False`` traces the
     exact pre-telemetry program (``dynamics`` is then an empty pytree).
+
+    ``return_adapted`` (the serving adapted-params cache) appends the
+    post-adaptation fast weights — the scan's final ``theta`` carry, the
+    exact dict the last target forward consumed — as a fifth aux element.
+    The forward math is untouched: ``theta_f`` is already computed as the
+    scan carry; returning it only keeps it from being DCE'd.
     """
 
     def inner_step(frozen, lslr_params, x_s, y_s, x_t, y_t, carry, step):
@@ -248,9 +255,12 @@ def _task_learner(
                 **extras,
                 "target_losses": jax.lax.stop_gradient(t_losses),
             }
-        return loss, (
+        aux = (
             correct, bn_f, jax.nn.softmax(final_logits, axis=-1), dynamics
         )
+        if return_adapted:
+            aux = aux + (theta_f,)
+        return loss, aux
 
     return task_loss
 
@@ -699,7 +709,41 @@ def make_eval_step(cfg: MAMLConfig, decode_uint8: Optional[bool] = None):
 SERVE_DONATE = (0,)
 
 
-def make_serve_step(cfg: MAMLConfig):
+def _serve_outputs(losses, correct, preds, valid, adapted=None):
+    """The shared serving epilogue: barrier-materialize the per-tenant
+    stacks, then the masked tenant-mean metrics.
+
+    The ``optimization_barrier`` materializes the per-tenant stacks
+    before the masked reductions, so the extra consumers the mask (and,
+    when the adapted-params cache is on, the fast-weights output)
+    introduces can never perturb the per-task codegen the bit-exactness
+    contracts rest on (same discipline as the indexed train factories).
+    """
+    stacks = (losses, correct, preds)
+    if adapted is not None:
+        stacks = stacks + (adapted,)
+    stacks = jax.lax.optimization_barrier(stacks)
+    losses, correct, preds = stacks[:3]
+    mask = valid.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    per_tenant_acc = jnp.mean(correct, axis=-1)
+    out = {
+        "preds": preds,
+        "loss": losses,
+        "accuracy": per_tenant_acc,
+        "metrics": {
+            "loss": jnp.sum(losses.astype(jnp.float32) * mask) / denom,
+            "accuracy": jnp.sum(per_tenant_acc * mask) / denom,
+        },
+    }
+    if adapted is not None:
+        out["adapted"] = stacks[3]
+    return out
+
+
+def make_serve_step(
+    cfg: MAMLConfig, ingest: str = "f32", return_adapted: bool = False
+):
     """Build the adapt-then-predict serving step.
 
     Signature: (state, x_s, y_s, x_t, y_t, valid) -> (state, out) where
@@ -721,50 +765,217 @@ def make_serve_step(cfg: MAMLConfig):
     inner steps, final-step-only loss weights), same matmul-precision
     scope — so serving predictions are bit-exact with
     ``make_eval_step`` / ``make_eval_multi_step`` outputs at the same
-    tenant width (tests/test_serving.py). The ``optimization_barrier``
-    materializes the per-tenant stacks before the masked reductions, so
-    the extra consumers the mask introduces can never perturb the
-    per-task codegen the equivalence rests on (same discipline as the
-    indexed train factories).
+    tenant width (tests/test_serving.py).
 
-    Batches arrive as float32 host pixels (the request frontend assembles
-    NHWC float32; the uint8 serving ingest tier is future work), so the
-    uint8_stream decode prelude is deliberately NOT applied here.
+    ``ingest='uint8'`` accepts raw uint8 pixel batches and decodes them
+    on device through the device-pipeline LUT
+    (``ops.device_pipeline.make_decoder`` — bit-exact with the host
+    decode by construction), cutting per-dispatch H2D pixel bytes 4x.
+    The decoded batches are barrier-materialized before the adapt body,
+    so the decode can never fuse into the per-tenant task bodies: the
+    downstream program consumes the same batch-shaped inputs as the f32
+    program and the uint8-vs-f32 bit-exactness contract holds structurally
+    (the same discipline — and the same reason — as the indexed train
+    factories).
+
+    ``return_adapted`` (the adapted-params cache) adds ``out['adapted']``:
+    the per-tenant post-adaptation fast weights, each leaf (bucket, ...) —
+    the exact arrays the final target forward consumed, which is what
+    makes a later ``make_predict_step`` dispatch over them bit-exact with
+    this full adaptation.
     """
+    if ingest not in ("f32", "uint8"):
+        raise ValueError(
+            f"make_serve_step ingest must be 'f32' or 'uint8', got "
+            f"{ingest!r} (the index ingest is make_serve_step_indexed)"
+        )
     num_steps = cfg.number_of_evaluation_steps_per_iter
-    learner = _task_learner(cfg, num_steps, second_order=False)
+    learner = _task_learner(
+        cfg, num_steps, second_order=False, return_adapted=return_adapted
+    )
     loss_weights = jnp.asarray(msl_lib.final_step_only(num_steps))
+    decode = (
+        device_pipeline.make_decoder(cfg) if ingest == "uint8" else None
+    )
 
     def serve_step(state: MetaState, x_s, y_s, x_t, y_t, valid):
         # same per-step precision scoping as train/eval (see train_step)
         with jax.default_matmul_precision(cfg.resolved_matmul_precision):
-            losses, (correct, _, preds, _) = _map_tasks(
+            if decode is not None:
+                x_s, x_t = decode(x_s), decode(x_t)
+                x_s, y_s, x_t, y_t = jax.lax.optimization_barrier(
+                    (x_s, y_s, x_t, y_t)
+                )
+            losses, aux = _map_tasks(
                 lambda xs, ys, xt, yt: learner(
                     state.net, state.lslr, state.bn, xs, ys, xt, yt,
                     loss_weights
                 ),
                 cfg.task_axis_mode, x_s, y_s, x_t, y_t,
             )
-            losses, correct, preds = jax.lax.optimization_barrier(
-                (losses, correct, preds)
+            out = _serve_outputs(
+                losses, aux[0], aux[2], valid,
+                adapted=aux[4] if return_adapted else None,
             )
-            mask = valid.astype(jnp.float32)
-            denom = jnp.maximum(jnp.sum(mask), 1.0)
-            per_tenant_acc = jnp.mean(correct, axis=-1)
-            out = {
-                "preds": preds,
-                "loss": losses,
-                "accuracy": per_tenant_acc,
-                "metrics": {
-                    "loss": jnp.sum(
-                        losses.astype(jnp.float32) * mask
-                    ) / denom,
-                    "accuracy": jnp.sum(per_tenant_acc * mask) / denom,
-                },
-            }
             return state, out
 
     return serve_step
+
+
+def make_serve_step_indexed(
+    cfg: MAMLConfig, shots: int, return_adapted: bool = False
+):
+    """The index-ingest serving step (``serving_ingest='index'``).
+
+    Signature: (state, store, gather, valid) -> (state, out) — the
+    resident uint8 store is a program parameter exactly like the indexed
+    train factories (never donated: it is a registry-owned invariant
+    reused by every dispatch), ``gather`` is the (bucket, way,
+    shots + targets) int32 store-row tensor, and per-dispatch H2D drops
+    to the index tensor + mask (<1KB at paper geometry). Labels never
+    cross H2D: sample (i, j) carries label i by construction (slot iota
+    — ``ops.device_pipeline.make_serve_expander``). The expanded batch is
+    barrier-materialized before the adapt body (see ``make_serve_step``'s
+    uint8 note), so the body is the f32 program's verbatim and
+    index-vs-f32 bit-exactness holds structurally. ``shots`` is static —
+    one compiled program per (bucket, shots), like the pixel ingests.
+    ``out`` is ``make_serve_step``'s contract unchanged (incl.
+    ``return_adapted``).
+    """
+    num_steps = cfg.number_of_evaluation_steps_per_iter
+    learner = _task_learner(
+        cfg, num_steps, second_order=False, return_adapted=return_adapted
+    )
+    loss_weights = jnp.asarray(msl_lib.final_step_only(num_steps))
+    expand = device_pipeline.make_serve_expander(cfg, shots)
+
+    def serve_step(state: MetaState, store, gather, valid):
+        with jax.default_matmul_precision(cfg.resolved_matmul_precision):
+            x_s, y_s, x_t, y_t = jax.lax.optimization_barrier(
+                expand(store, gather)
+            )
+            losses, aux = _map_tasks(
+                lambda xs, ys, xt, yt: learner(
+                    state.net, state.lslr, state.bn, xs, ys, xt, yt,
+                    loss_weights
+                ),
+                cfg.task_axis_mode, x_s, y_s, x_t, y_t,
+            )
+            out = _serve_outputs(
+                losses, aux[0], aux[2], valid,
+                adapted=aux[4] if return_adapted else None,
+            )
+            return state, out
+
+    return serve_step
+
+
+#: donated argnums of ``make_predict_step`` — the same passthrough-state
+#: aliasing contract as ``SERVE_DONATE`` (the cached fast weights are NOT
+#: donated: they are cache-owned host arrays uploaded per dispatch)
+PREDICT_DONATE = (0,)
+
+
+def _predict_body(cfg: MAMLConfig):
+    """The shared predict-only per-tenant body + masked epilogue (see
+    ``make_predict_step``): (state, fast, x_t, y_t, valid) -> out, with
+    ``x_t`` already decoded float pixels."""
+    last_step = cfg.number_of_evaluation_steps_per_iter - 1
+
+    def body(state: MetaState, fast, x_t, y_t, valid):
+        _, frozen = partition.split_inner(cfg, state.net)
+
+        def per_tenant(th, xt, yt):
+            # same flatten as _task_learner.task_loss
+            x = xt.reshape((-1,) + xt.shape[-3:])
+            y = yt.reshape(-1)
+            logits, _ = vgg.apply(
+                cfg, {**frozen, **th}, state.bn, x, last_step,
+                training=True,
+            )
+            return (
+                F.cross_entropy(logits, y),
+                F.accuracy(logits, y),
+                jax.nn.softmax(logits, axis=-1),
+            )
+
+        if cfg.task_axis_mode == "map":
+            losses, correct, preds = jax.lax.map(
+                lambda a: per_tenant(*a), (fast, x_t, y_t)
+            )
+        else:
+            losses, correct, preds = jax.vmap(per_tenant)(fast, x_t, y_t)
+        return _serve_outputs(losses, correct, preds, valid)
+
+    return body
+
+
+def make_predict_step(cfg: MAMLConfig, ingest: str = "f32"):
+    """The cache-hit serving program: predict-only, NO inner loop.
+
+    Signature: (state, fast, x_t, y_t, valid) -> (state, out) where
+    ``fast`` is the per-tenant adapted fast-weight pytree (each leaf
+    (bucket, ...) — a ``make_serve_step(return_adapted=True)`` dispatch's
+    ``out['adapted']``, round-tripped through the host adapted-params
+    cache), and ``out`` is the serve step's contract minus ``adapted``.
+
+    The per-tenant math is EXACTLY the final target forward of the adapt
+    program — ``vgg.apply({**frozen, **fast}, ...)`` at inner-step index
+    ``num_eval_steps - 1`` with ``training=True`` — so a cache hit is
+    bit-exact with full re-adaptation at the same tenant width: the fast
+    weights are the same arrays the adapt program's last forward consumed
+    (f32 host round-trip is exact), and batch-norm always normalizes with
+    the CURRENT batch's statistics (``ops.functional.batch_norm``), so the
+    per-tenant BN running-stat evolution the adapt path tracks — the only
+    state this program does not replay — never touches the logits.
+
+    ``ingest='uint8'`` decodes the query batch on device (the serve
+    step's LUT prelude + barrier, same bit-exactness argument).
+
+    Cost: forward GEMMs only — no support gradient, no inner-loop chain;
+    the op census carries one forward's worth of dot/conv ops and zero
+    inner-loop gradient ops (pinned by `cli audit` / the serving tests).
+    """
+    if ingest not in ("f32", "uint8"):
+        raise ValueError(
+            f"make_predict_step ingest must be 'f32' or 'uint8', got "
+            f"{ingest!r} (the index ingest is make_predict_step_indexed)"
+        )
+    body = _predict_body(cfg)
+    decode = (
+        device_pipeline.make_decoder(cfg) if ingest == "uint8" else None
+    )
+
+    def predict_step(state: MetaState, fast, x_t, y_t, valid):
+        with jax.default_matmul_precision(cfg.resolved_matmul_precision):
+            if decode is not None:
+                x_t, y_t = jax.lax.optimization_barrier(
+                    (decode(x_t), y_t)
+                )
+            return state, body(state, fast, x_t, y_t, valid)
+
+    return predict_step
+
+
+def make_predict_step_indexed(cfg: MAMLConfig):
+    """The index-ingest predict-only program (cache hits under
+    ``serving_ingest='index'``).
+
+    Signature: (state, fast, store, gather, valid) -> (state, out) with
+    ``gather`` the (bucket, way, targets) int32 QUERY store rows (no
+    support rows — a cache hit ships no support set at all) and labels
+    slot iota, exactly like the adapt-side serve expander."""
+    body = _predict_body(cfg)
+    decode = device_pipeline.make_decoder(cfg)
+
+    def predict_step(state: MetaState, fast, store, gather, valid):
+        with jax.default_matmul_precision(cfg.resolved_matmul_precision):
+            x_t = decode(store[gather])
+            y_t = jax.lax.broadcasted_iota(jnp.int32, gather.shape, 1)
+            x_t, y_t = jax.lax.optimization_barrier((x_t, y_t))
+            return state, body(state, fast, x_t, y_t, valid)
+
+    return predict_step
 
 
 # -- device-resident (index-only H2D) step variants -------------------------

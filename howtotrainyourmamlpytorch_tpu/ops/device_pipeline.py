@@ -175,6 +175,37 @@ def make_sharded_gather(
     return gather_decode
 
 
+def make_serve_expander(
+    cfg: MAMLConfig, shots: int
+) -> Callable[[jnp.ndarray, jnp.ndarray], Tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray
+]]:
+    """(store, gather) -> (x_s, y_s, x_t, y_t) for the serving index
+    ingest (``serving_ingest='index'``).
+
+    The serving twin of ``make_index_expander``: ``store`` is a resident
+    (N, h, w, c) uint8 image store (a registered ``FlatStore``'s data,
+    uploaded once), ``gather`` the (tenants, n_way, shots + targets)
+    int32 flat store rows of each tenant's support-then-query draw.
+    Labels never cross H2D: sample (i, j) of any tenant carries label i
+    by construction (slot iota), the training index-path convention — an
+    index request's support/query rows are grouped by class slot.
+    No rotation branch: serving never augments (the ``augment_stack``
+    gate is train-time only), so the decode is the pure LUT lookup and
+    stays bit-exact with the host pipeline for every dataset family.
+    ``shots`` is static — each shots bucket is its own compiled program,
+    exactly like the pixel-ingest serve programs.
+    """
+    decode = make_decoder(cfg)
+
+    def expand(store, gather):
+        x = decode(store[gather])  # (tenants, n, shots+t, h, w, c)
+        y = jax.lax.broadcasted_iota(jnp.int32, gather.shape, 1)
+        return x[:, :, :shots], y[:, :, :shots], x[:, :, shots:], y[:, :, shots:]
+
+    return expand
+
+
 def make_index_expander(
     cfg: MAMLConfig, augment: bool, store_mesh=None,
     store_axis: Optional[str] = None,

@@ -8,25 +8,33 @@ concurrent-TENANT axis and serves that adapt-then-predict program as a
 request-driven hot path:
 
 * :mod:`serving.engine`  — ``ServingEngine``: loads a training checkpoint
-  (read-only) into a servable snapshot, pre-compiles the donated
-  ``core.maml.make_serve_step`` program for every (tenant-bucket, shots)
-  point of the static bucket ladder at startup (warm-started from the
-  persistent ``xla_cache`` when configured), and dispatches padded,
-  masked multi-tenant batches under a strict ``RetraceDetector``;
+  (read-only) into a servable snapshot, pre-compiles the donated serving
+  program family for every (tenant-bucket, shots) point of the static
+  bucket ladder at startup (or DESERIALIZES it from AOT export
+  artifacts — zero XLA compiles), and dispatches padded, masked
+  multi-tenant batches under a strict ``RetraceDetector``. Three ingest
+  tiers (f32 / uint8 / store-index — ``serving_ingest``) and an
+  adapted-params LRU cache (``serving_adapted_cache_size``) that routes
+  repeat tenants to the inner-loop-free predict program;
 * :mod:`serving.batcher` — the host-side micro-batching front end:
   per-shots-bucket queues with ``serving_max_wait_ms`` /
   ``serving_max_tenants_per_dispatch`` knobs (``MicroBatcher``), plus the
-  synchronous ``serve_requests`` API;
+  synchronous ``serve_requests`` API; pixel requests (``AdaptRequest``)
+  and store-row requests (``IndexRequest``);
+* :mod:`serving.export`  — the ``cli serve-export`` AOT artifact writer
+  (``jax.experimental.serialize_executable`` payloads keyed by
+  device-kind/dtype/config-fingerprint);
 * :mod:`serving.bench`   — the ``cli serve-bench`` closed-loop load
-  generator (latency p50/p95 + tenants/sec, telemetry ``serving``
-  records).
+  generator (latency p50/p95 + tenants/sec + H2D bytes + cache hit
+  rate, telemetry ``serving`` records).
 """
 
-from .batcher import AdaptRequest, MicroBatcher, serve_requests
+from .batcher import AdaptRequest, IndexRequest, MicroBatcher, serve_requests
 from .engine import ServingEngine, load_servable_snapshot
 
 __all__ = [
     "AdaptRequest",
+    "IndexRequest",
     "MicroBatcher",
     "ServingEngine",
     "load_servable_snapshot",

@@ -15,6 +15,8 @@ Two entry points share the grouping policy:
   has waited ``serving_max_wait_ms`` — the classic max-batch/max-wait
   latency-throughput dial. Per-request queue time rides into the
   telemetry ``serving`` records as the dispatch's mean ``queue_ms``.
+  ``close()`` SERVES every queued request before the worker exits (and
+  fails — never strands — anything a crashed worker left behind).
 
 Shots are a BUCKET KEY, never a padding axis: requests with different
 support-shot counts go to different queues and different compiled
@@ -35,13 +37,16 @@ import numpy as np
 
 @dataclass
 class AdaptRequest:
-    """One tenant's adapt-then-predict request.
+    """One tenant's adapt-then-predict request (pixel ingests).
 
-    Arrays are NHWC float32 / int32, matching the engine config's task
-    geometry: ``support_x`` (way, shots, h, w, c), ``support_y``
-    (way, shots), ``query_x`` (way, targets, h, w, c), and optionally
-    ``query_y`` (way, targets) when the caller wants query loss/accuracy
-    back (predictions never need labels).
+    Arrays are NHWC, matching the engine config's task geometry:
+    ``support_x`` (way, shots, h, w, c), ``support_y`` (way, shots),
+    ``query_x`` (way, targets, h, w, c), and optionally ``query_y``
+    (way, targets) when the caller wants query loss/accuracy back
+    (predictions never need labels). Pixel dtype follows the engine's
+    ingest tier: float32 decoded pixels for ``ingest='f32'``, RAW uint8
+    pixels for ``ingest='uint8'`` (decoded on device — the engine
+    refuses a mismatched dtype rather than silently casting).
     """
 
     support_x: np.ndarray
@@ -53,6 +58,32 @@ class AdaptRequest:
     @property
     def shots(self) -> int:
         return int(np.asarray(self.support_x).shape[1])
+
+
+@dataclass
+class IndexRequest:
+    """One tenant's request as STORE ROWS (``ingest='index'``).
+
+    The engine holds a registered uint8 ``FlatStore`` resident in HBM;
+    an index request ships only int32 row tensors — ``support_idx``
+    (way, shots) and ``query_idx`` (way, targets) — so per-request H2D
+    is a few hundred bytes. Labels never cross H2D: sample (i, j) of
+    either set carries label i by construction (slot iota — rows must be
+    grouped by class slot, the training index-path convention).
+    ``labeled=False`` marks a tenant whose query grouping is NOT
+    truthful (unknown query classes): its predictions are unaffected,
+    but it is masked out of loss/accuracy like a label-free pixel
+    request.
+    """
+
+    support_idx: np.ndarray
+    query_idx: np.ndarray
+    labeled: bool = True
+    tenant_id: Optional[str] = None
+
+    @property
+    def shots(self) -> int:
+        return int(np.asarray(self.support_idx).shape[1])
 
 
 def group_requests(
@@ -174,11 +205,38 @@ class MicroBatcher:
         return pending
 
     def close(self) -> None:
-        """Drain every queue, then stop the worker thread."""
+        """Drain every queue, then stop the worker thread.
+
+        In-flight requests at close() are SERVED (the worker dispatches
+        every non-empty per-shots queue before exiting — the drain
+        guarantee), and anything that could NOT be served — the worker
+        crashed, or died before reaching a queue — is FAILED with the
+        root cause, never left as a hanging future: ``close()`` sweeps
+        the queues after the join as a final safety net (a dead worker's
+        join returns immediately, which previously stranded its queued
+        futures forever).
+        """
         with self._cond:
             self._closed = True
             self._cond.notify()
         self._worker.join()
+        self._fail_pending(
+            RuntimeError(
+                "MicroBatcher closed before this request could be served "
+                "(worker exited early)"
+            )
+        )
+
+    def _fail_pending(self, error: BaseException) -> None:
+        """Fail every still-queued request (worker crash / late close
+        safety net); requests already served are untouched."""
+        with self._cond:
+            leftovers = [p for q in self._queues.values() for p in q]
+            self._queues.clear()
+        for p in leftovers:
+            if not p.done.is_set():
+                p.error = error
+                p.done.set()
 
     # -- worker ------------------------------------------------------------
 
@@ -220,6 +278,20 @@ class MicroBatcher:
         )
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except BaseException as e:  # noqa: BLE001 - worker crash: the
+            # queues' futures must FAIL with the root cause, not hang
+            # forever waiting on a dead thread
+            err = RuntimeError(
+                "MicroBatcher worker crashed; request was never "
+                "dispatched (root cause chained below)"
+            )
+            err.__cause__ = e
+            self._fail_pending(err)
+            raise
+
+    def _run_loop(self) -> None:
         while True:
             with self._cond:
                 group = self._ripe_group()

@@ -12,14 +12,23 @@ strict: any mid-run recompile fails the bench). Prints ONE JSON line:
     "unit": "ms", "adaptation_latency_ms_p50": ..., "..._p95": ...,
     "tenants_per_sec": ..., "dispatches": ..., "tenants": ...,
     "warmup_seconds": ..., "retraces": 0, "backend": ...,
-    "bucket_ladder": [...], "shots_buckets": [...]}
+    "ingest": "f32|uint8|index", "h2d_bytes_per_dispatch": ...,
+    "cache_hit_rate": ..., "warmup_mode": "compile|artifacts",
+    "warmup_xla_compiles": ..., "bucket_ladder": [...],
+    "shots_buckets": [...]}
 
 With ``--telemetry PATH`` the per-dispatch ``serving`` records plus the
-final rollup go to a schema-v8 JSONL log that ``cli inspect summary``
+final rollup go to a schema-v9 JSONL log that ``cli inspect summary``
 renders and the CI serving-smoke job schema-validates. ``--checkpoint
 DIR`` serves a real training checkpoint (restored READ-ONLY) instead of
 a fresh ``init_state`` snapshot; ``--fast`` shrinks the workload to a
-seconds-scale smoke (the CI gate).
+seconds-scale smoke (the CI gate). ``--ingest`` selects the serving
+ingest tier (the H2D bytes land in the JSON line, so the uint8/index
+reductions are measurable under the same closed-loop protocol);
+``--repeat-tenant-fraction`` mixes repeat tenants in (adapted-params
+cache hits — ``cache_hit_rate`` lands in the line); ``--export-dir``
+warms the engine from AOT export artifacts (``cli serve-export``),
+reporting ``warmup_mode`` and the warmup's XLA compile count.
 
 Exit codes: 0 on success (including the emitted line), nonzero on any
 failure — a retrace, a schema-invalid record, a broken engine.
@@ -67,36 +76,87 @@ def _bench_cfg(args):
     return cfg
 
 
+def bench_shots_buckets(cfg) -> List[int]:
+    """The bench's shots ladder: two buckets, so even the smoke workload
+    proves the mixed-bucket no-retrace contract. Shared with
+    ``cli serve-export`` so exported artifact fingerprints match the
+    engine serve-bench builds."""
+    return sorted({cfg.num_samples_per_class,
+                   cfg.num_samples_per_class + 1})
+
+
+def _synth_store(cfg, rows: int = 256, seed: int = 7) -> np.ndarray:
+    """A deterministic synthetic uint8 store for the index ingest."""
+    rng = np.random.RandomState(seed)
+    h, w, c = cfg.im_shape
+    return rng.randint(0, 256, (rows, h, w, c)).astype(np.uint8)
+
+
+def _synth_request(cfg, rng, shots: int, ingest: str, store_rows: int,
+                   tenant_id: str):
+    from .batcher import AdaptRequest, IndexRequest
+
+    n, t = cfg.num_classes_per_set, cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    if ingest == "index":
+        return IndexRequest(
+            support_idx=rng.randint(
+                0, store_rows, (n, shots)
+            ).astype(np.int32),
+            query_idx=rng.randint(0, store_rows, (n, t)).astype(np.int32),
+            labeled=True,
+            tenant_id=tenant_id,
+        )
+    if ingest == "uint8":
+        sx = rng.randint(0, 256, (n, shots, h, w, c)).astype(np.uint8)
+        qx = rng.randint(0, 256, (n, t, h, w, c)).astype(np.uint8)
+    else:
+        sx = rng.randn(n, shots, h, w, c).astype(np.float32)
+        qx = rng.randn(n, t, h, w, c).astype(np.float32)
+    return AdaptRequest(
+        support_x=sx,
+        support_y=np.tile(np.arange(n, dtype=np.int32)[:, None], (1, shots)),
+        query_x=qx,
+        query_y=np.tile(np.arange(n, dtype=np.int32)[:, None], (1, t)),
+        tenant_id=tenant_id,
+    )
+
+
 def _synth_groups(cfg, shots_buckets, n_requests: int, cap: int,
-                  seed: int) -> List[List]:
+                  seed: int, ingest: str = "f32", store_rows: int = 0,
+                  repeat_fraction: float = 0.0) -> List[List]:
     """Deterministic synthetic traffic as DISPATCH GROUPS: group sizes
     cycle 1..cap (every tenant bucket sees steady traffic) and each
     group's shots bucket cycles the configured ladder (every compiled
     program sees steady traffic) — the mixed-bucket pattern the
-    zero-retrace contract must hold under."""
-    from .batcher import AdaptRequest
+    zero-retrace contract must hold under.
 
+    ``repeat_fraction`` > 0 makes that fraction of requests REPEAT
+    TENANTS: they reuse a previously generated request's support set
+    (same content fingerprint — an adapted-params-cache hit once the
+    first occurrence has been adapted), modelling the
+    same-tenant-returns traffic the cache fast path exists for."""
     rng = np.random.RandomState(seed)
-    n, t = cfg.num_classes_per_set, cfg.num_target_samples
-    h, w, c = cfg.im_shape
     groups: List[List] = []
+    # repeat pool per shots bucket: a reused tenant must reuse its own
+    # shots count or the fingerprints can never collide
+    pool: dict = {s: [] for s in shots_buckets}
     size, total, g = 1, 0, 0
     while total < n_requests:
         take = min(size, n_requests - total)
         s = shots_buckets[g % len(shots_buckets)]
         group = []
         for _ in range(take):
-            group.append(AdaptRequest(
-                support_x=rng.randn(n, s, h, w, c).astype(np.float32),
-                support_y=np.tile(
-                    np.arange(n, dtype=np.int32)[:, None], (1, s)
-                ),
-                query_x=rng.randn(n, t, h, w, c).astype(np.float32),
-                query_y=np.tile(
-                    np.arange(n, dtype=np.int32)[:, None], (1, t)
-                ),
-                tenant_id=f"tenant-{total + len(group)}",
-            ))
+            if pool[s] and rng.rand() < repeat_fraction:
+                prev = pool[s][rng.randint(len(pool[s]))]
+                group.append(prev)
+            else:
+                req = _synth_request(
+                    cfg, rng, s, ingest, store_rows,
+                    tenant_id=f"tenant-{total + len(group)}",
+                )
+                pool[s].append(req)
+                group.append(req)
         groups.append(group)
         total += take
         g += 1
@@ -133,8 +193,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--telemetry", default=None, metavar="PATH",
                         help="write serving telemetry records (JSONL, "
-                             "schema v8) to this path")
+                             "schema v9) to this path")
+    parser.add_argument("--ingest", default=None,
+                        choices=["f32", "uint8", "index"],
+                        help="serving ingest tier to drive (default: the "
+                             "config's serving_ingest): f32 host pixels, "
+                             "uint8 device-decoded pixels (~4x less H2D), "
+                             "or index-only dispatch against a synthetic "
+                             "resident store (<1KB H2D)")
+    parser.add_argument("--repeat-tenant-fraction", type=float, default=0.0,
+                        metavar="F",
+                        help="fraction of requests that repeat an earlier "
+                             "tenant's support set (adapted-params-cache "
+                             "hits; enables the cache when > 0)")
+    parser.add_argument("--cache-size", type=int, default=None,
+                        help="adapted-params LRU capacity (default: the "
+                             "config's serving_adapted_cache_size, or "
+                             "auto-enabled when --repeat-tenant-fraction "
+                             "> 0)")
+    parser.add_argument("--export-dir", default=None, metavar="DIR",
+                        help="AOT artifact root: warmup loads exported "
+                             "executables from here (zero XLA compiles) "
+                             "and falls back to compile-then-save — see "
+                             "cli serve-export")
     args = parser.parse_args(argv)
+    if not 0.0 <= args.repeat_tenant_fraction <= 1.0:
+        parser.error("--repeat-tenant-fraction must be in [0, 1]")
     if args.checkpoint and not args.config:
         parser.error(
             "--checkpoint requires --config: the checkpoint directory "
@@ -146,10 +230,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     cfg = _bench_cfg(args)
     n_requests = args.requests or (8 if args.fast else 64)
-    # two shots buckets prove the mixed-bucket no-retrace contract even
-    # on the smoke workload
-    shots_buckets = sorted({cfg.num_samples_per_class,
-                            cfg.num_samples_per_class + 1})
+    shots_buckets = bench_shots_buckets(cfg)
 
     from ..core import maml
     from .batcher import serve_requests
@@ -170,14 +251,27 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         sink = JsonlSink(args.telemetry)
 
+    ingest = args.ingest or cfg.serving_ingest
+    cache_size = args.cache_size
+    if cache_size is None:
+        cache_size = cfg.serving_adapted_cache_size
+        if args.repeat_tenant_fraction > 0 and cache_size == 0:
+            # a repeat-tenant workload without the cache measures
+            # nothing; auto-enable it at a capacity the workload fits
+            cache_size = max(64, n_requests)
+    store = _synth_store(cfg) if ingest == "index" else None
+
     engine = ServingEngine(
         cfg, state, shots_buckets=shots_buckets, sink=sink,
-        strict_retrace=True,
+        strict_retrace=True, ingest=ingest, store=store,
+        cache_size=cache_size,
     )
-    warmup_s = engine.warmup()
+    warmup_s = engine.warmup(artifact_dir=args.export_dir)
 
     groups = _synth_groups(
-        cfg, shots_buckets, n_requests, engine.max_tenants, args.seed
+        cfg, shots_buckets, n_requests, engine.max_tenants, args.seed,
+        ingest=ingest, store_rows=engine._store_rows,
+        repeat_fraction=args.repeat_tenant_fraction,
     )
     for group in groups:
         serve_requests(engine, group)
@@ -198,6 +292,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "tenants": rollup["tenants"],
         "retraces": rollup["retraces"],
         "warmup_seconds": round(warmup_s, 3),
+        # the fast-path acceptance surface: measured H2D per dispatch
+        # (the ingest tiers' ratio is the bench's 4x/index claim), cache
+        # hit rate, and how warmup materialized its programs (the AOT
+        # artifact path reports mode='artifacts' with 0 compiles)
+        "ingest": rollup["ingest"],
+        "h2d_bytes_per_dispatch": rollup["h2d_bytes_per_dispatch"],
+        "cache_hit_rate": rollup["cache_hit_rate"],
+        "cache_size": engine.cache_size,
+        "repeat_tenant_fraction": float(args.repeat_tenant_fraction),
+        "warmup_mode": engine.warmup_stats.get("mode"),
+        "warmup_xla_compiles": engine.warmup_stats.get("xla_compiles"),
         "bucket_ladder": list(engine.buckets),
         "shots_buckets": list(engine.shots_buckets),
         "max_tenants_per_dispatch": engine.max_tenants,

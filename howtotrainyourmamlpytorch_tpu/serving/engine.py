@@ -2,9 +2,8 @@
 
 The engine owns a servable snapshot (a ``MetaState`` restored READ-ONLY
 from a training checkpoint — no experiment-dir mutation, see
-``experiment.checkpoint.load_checkpoint(readonly=True)``) and the jitted
-``core.maml.make_serve_step`` program, dispatched at a fixed set of
-static shapes:
+``experiment.checkpoint.load_checkpoint(readonly=True)``) and a table of
+AOT-compiled programs dispatched at a fixed set of static shapes:
 
 * **tenant buckets** — every dispatch is padded up to the smallest
   ``serving_bucket_ladder`` entry >= its tenant count, with a float mask
@@ -15,32 +14,61 @@ static shapes:
   the config's ``num_samples_per_class`` only). Shots are never padded —
   pad support samples would enter the inner-loop adaptation loss.
 
-``warmup()`` compiles (and executes once, on zeros) every
-(bucket, shots) program at startup, so the first real request pays no
-compile; when the config points at a persistent compilation cache the
-compiles warm-start from the training run's ``xla_cache``. A STRICT
-``analysis.auditor.RetraceDetector`` watches every dispatch site: after
-warmup, any new abstract signature — i.e. any mid-run retrace — raises
-instead of silently paying a 20-40s TPU compile on a live request.
+**Ingest tiers** (``serving_ingest`` / the ``ingest`` ctor arg): 'f32'
+uploads host-assembled float32 pixels; 'uint8' uploads raw uint8 pixels
+and decodes on device through the device-pipeline LUT (bit-exact with
+the host decode by construction, ~4x less H2D per dispatch); 'index'
+requires a registered uint8 ``FlatStore`` (resident in HBM, uploaded
+once at engine construction) and ships only int32 store-row tensors per
+dispatch (<1KB) — labels never cross H2D (slot iota, the training
+index-path convention). Every dispatch's actual H2D byte count rides the
+telemetry (``ingest_bytes``) and the rollup (``h2d_bytes_per_dispatch``).
 
-State donation: the serve program passes the state through as an output
-and the jit donates it (``maml.SERVE_DONATE``) — the executable aliases
-the state buffers input->output (the donation contract the auditor
-checks), the engine re-binds its reference after every dispatch, and the
-snapshot stays single-buffered in HBM like the train family's state.
+**Adapted-params cache** (``serving_adapted_cache_size`` > 0): an LRU
+keyed by tenant support-set fingerprint (content hash + shots + snapshot
+id) storing each adapted tenant's post-inner-loop fast weights on the
+host. Repeat tenants skip the inner loop entirely: their queries ride
+the cheap predict-only program (``core.maml.make_predict_step`` —
+forward GEMMs only, zero inner-loop gradient ops), bit-exact with full
+re-adaptation at the same tenant width. Mixed hit/miss groups split
+cleanly into (at most) one adapt dispatch + one predict dispatch, each
+on its own bucket.
 
-Telemetry: every dispatch emits a schema-v8 ``serving`` record
-(event='dispatch': tenants, bucket, shots, queue_ms, adapt_ms) through
-``telemetry.sinks.make_record`` into an optional sink; ``rollup()``
-condenses the run into an event='rollup' record (adapt_ms p50/p95,
-tenants_per_sec) — the line ``cli inspect summary`` prints jax-free.
+``warmup()`` compiles (AOT) and executes once, on zeros, every program
+the engine can dispatch, so the first real request pays no compile —
+or, when an artifact directory is configured (``serving_export_dir`` /
+the ``artifact_dir`` argument / ``cli serve-export``), DESERIALIZES the
+previously exported executables instead: zero XLA compilations, with a
+compile-count assertion surface in ``warmup_stats`` (serving/export.py).
+On any artifact mismatch warmup falls back to compile-then-save. A
+STRICT ``analysis.auditor.RetraceDetector`` watches every dispatch site:
+after warmup, any new abstract signature — i.e. any mid-run retrace —
+raises instead of silently paying a 20-40s TPU compile on a live
+request.
+
+State donation: every serving program passes the state through as an
+output and donates it (``maml.SERVE_DONATE`` / ``maml.PREDICT_DONATE``)
+— the executable aliases the state buffers input->output (the donation
+contract the auditor checks), the engine re-binds its reference after
+every dispatch, and the snapshot stays single-buffered in HBM like the
+train family's state.
+
+Telemetry: every dispatch emits a schema-v9 ``serving`` record
+(event='dispatch': tenants, bucket, shots, queue_ms, adapt_ms, program,
+ingest, ingest_bytes, cache_hits) through ``telemetry.sinks.make_record``
+into an optional sink; warmup emits an event='warmup' record (mode,
+warmup_ms, xla_compiles); ``rollup()`` condenses the run into an
+event='rollup' record (adapt_ms p50/p95, tenants_per_sec,
+h2d_bytes_per_dispatch, cache_hit_rate) — the line ``cli inspect
+summary`` prints jax-free.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -68,7 +96,14 @@ class TenantResult:
 
 @dataclass
 class DispatchResult:
-    """One dispatch's results + the latency the telemetry records."""
+    """One group's results + the latency the telemetry records.
+
+    With the adapted-params cache on, a group may have split into one
+    adapt dispatch (misses) plus one predict dispatch (hits):
+    ``adapt_ms`` is then the summed device latency, ``bucket`` the adapt
+    dispatch's bucket (the predict bucket when the group was all hits),
+    and ``cache_hits`` how many tenants skipped the inner loop.
+    """
 
     results: List[TenantResult]
     tenants: int
@@ -78,6 +113,8 @@ class DispatchResult:
     adapt_ms: float
     metrics: Dict[str, float]  # masked tenant-mean loss/accuracy over
     # the LABELED tenants (0 when the dispatch carried none)
+    cache_hits: int = 0
+    ingest_bytes: int = 0  # actual H2D payload bytes of the dispatches
 
 
 def load_servable_snapshot(
@@ -140,16 +177,27 @@ class ServingEngine:
 
     :param cfg: fixes the task geometry (way / query targets / image
         shape) and the serving knobs (``serving_bucket_ladder``,
-        ``serving_max_tenants_per_dispatch``).
+        ``serving_max_tenants_per_dispatch``, ``serving_ingest``,
+        ``serving_adapted_cache_size``, ``serving_export_dir``).
     :param state: the servable ``MetaState`` (host numpy or device
         arrays) — from ``load_servable_snapshot`` or ``maml.init_state``.
     :param shots_buckets: support-shot counts to compile programs for
         (default: the config's ``num_samples_per_class`` only).
     :param sink: optional telemetry sink (``telemetry.sinks.JsonlSink``
         or anything with ``write(record)``); records are built through
-        ``make_record`` (schema v8 ``serving`` kind).
+        ``make_record`` (schema v9 ``serving`` kind).
     :param strict_retrace: raise ``RetraceError`` on any post-warmup
         recompile (the production default); False records events only.
+    :param ingest: override ``cfg.serving_ingest`` for this engine.
+    :param store: the registered uint8 image store for the 'index'
+        ingest — a ``data.preprocess.FlatStore`` or a raw (N, h, w, c)
+        uint8 array; uploaded to HBM ONCE here, then every dispatch
+        gathers from it on device.
+    :param cache_size: override ``cfg.serving_adapted_cache_size``.
+    :param snapshot_id: identity of the served checkpoint for the
+        adapted-params cache key (default: a content hash of the state —
+        two engines over the same snapshot agree, a new checkpoint
+        invalidates every cached tenant by construction).
     """
 
     #: latency-sample window for the rollup percentiles (last N
@@ -163,12 +211,20 @@ class ServingEngine:
         shots_buckets: Optional[Sequence[int]] = None,
         sink=None,
         strict_retrace: bool = True,
+        ingest: Optional[str] = None,
+        store=None,
+        cache_size: Optional[int] = None,
+        snapshot_id: Optional[str] = None,
     ):
         import jax
+        import jax.numpy as jnp
 
         from ..analysis.auditor import RetraceDetector
-        from ..core import maml
+        from . import export as export_lib
 
+        # counting XLA compiles is warmup's acceptance surface; install
+        # the listener before any serving program can compile
+        export_lib.install_compile_counter()
         self.cfg = cfg
         self.buckets: Tuple[int, ...] = tuple(cfg.serving_bucket_ladder)
         self.max_tenants: int = cfg.serving_max_tenants_per_dispatch
@@ -181,26 +237,92 @@ class ServingEngine:
             raise ValueError(
                 f"shots buckets must be >= 1, got {self.shots_buckets}"
             )
+        self.ingest: str = cfg.serving_ingest if ingest is None else ingest
+        if self.ingest not in ("f32", "uint8", "index"):
+            raise ValueError(
+                f"ingest must be 'f32', 'uint8' or 'index', got "
+                f"{self.ingest!r}"
+            )
+        self.cache_size: int = (
+            cfg.serving_adapted_cache_size
+            if cache_size is None else int(cache_size)
+        )
+        if self.cache_size < 0:
+            raise ValueError(
+                f"cache_size must be >= 0, got {self.cache_size}"
+            )
         self.sink = sink
         # the engine OWNS its device snapshot: every dispatch donates the
         # state and re-binds to the (aliased) returned one, so the buffers
         # must be private — ``jnp.array(copy=True)`` (plain device_put is
         # a no-op for an already-committed array and would donate the
         # CALLER's buffers out from under it)
-        import jax.numpy as jnp
-
         self._state = jax.tree_util.tree_map(
             lambda x: jnp.array(x, copy=True), state
         )
-        self._step = jax.jit(
-            maml.make_serve_step(cfg), donate_argnums=maml.SERVE_DONATE
-        )
+        # 'index' ingest: the registered store is uploaded ONCE and is a
+        # program parameter of every dispatch (never donated — the
+        # resident invariant, exactly like the indexed train factories)
+        self._store = None
+        self._store_rows = 0
+        store_fp = ""
+        if self.ingest == "index":
+            if store is None:
+                raise ValueError(
+                    "ingest='index' requires a registered store (a "
+                    "data.preprocess.FlatStore or a (N, h, w, c) uint8 "
+                    "array): index requests reference its rows"
+                )
+            data = np.asarray(getattr(store, "data", store))
+            if data.dtype != np.uint8 or data.shape[1:] != cfg.im_shape:
+                raise ValueError(
+                    f"registered store must be (N, {cfg.im_shape[0]}, "
+                    f"{cfg.im_shape[1]}, {cfg.im_shape[2]}) uint8, got "
+                    f"{data.shape} {data.dtype}"
+                )
+            self._store_rows = int(data.shape[0])
+            if self.cache_size > 0:
+                # the store content hash is a cache-key component only —
+                # never pay a full-store SHA1 when the cache is off
+                store_fp = hashlib.sha1(
+                    np.ascontiguousarray(data)
+                ).hexdigest()
+            self._store = jnp.asarray(data)
+        elif store is not None:
+            raise ValueError(
+                f"a registered store only applies to ingest='index' "
+                f"(this engine is ingest={self.ingest!r})"
+            )
         self.retrace_detector = RetraceDetector(strict=strict_retrace)
         # a dispatch that fails AFTER donation leaves self._state pointing
         # at deleted buffers; the engine marks itself dead with the root
         # cause so later requests fail fast naming it, instead of a
         # stream of unrelated "buffer was donated/deleted" errors
         self._dead: Optional[BaseException] = None
+        # AOT program table: (family, bucket, shots) -> compiled
+        # executable; filled by warmup() (artifact load or AOT compile),
+        # lazily completed for unwarmed points (a first compile at a NEW
+        # site is legal; a SECOND signature at one site is the retrace
+        # the strict detector kills)
+        self._programs: Dict[Tuple[str, int, int], Any] = {}
+        self.warmup_stats: Dict[str, Any] = {}
+        # adapted-params cache: support-set fingerprint -> host fast
+        # weights (the LRU the predict-only program serves hits from)
+        self._cache: "OrderedDict[str, Dict[str, np.ndarray]]" = (
+            OrderedDict()
+        )
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._cache_salt = b""
+        if self.cache_size > 0:
+            # the snapshot fingerprint (a full host fetch + SHA1 over the
+            # state) is a cache-key component only — skipped when the
+            # cache is off, so default engines pay nothing for it
+            if snapshot_id is None:
+                snapshot_id = self._state_fingerprint()
+            self._cache_salt = (
+                f"{snapshot_id}|{self.ingest}|{store_fp}|".encode()
+            )
         # rollup accumulators (per-dispatch samples, warmup excluded);
         # throughput is measured over the wall-clock SPAN from the first
         # real dispatch's start to the last one's end — summing per-
@@ -212,34 +334,76 @@ class ServingEngine:
         # latency instead of a lifetime aggregate.
         self._adapt_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self._queue_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        self._h2d_bytes: Deque[int] = deque(maxlen=self.LATENCY_WINDOW)
         self._tenants_served = 0
         self._span_start: Optional[float] = None
         self._span_end: Optional[float] = None
 
+    # -- identity ----------------------------------------------------------
+
+    def _state_fingerprint(self) -> str:
+        """Content hash of the served snapshot (cache-key component): a
+        one-time pass over the state leaves at engine construction."""
+        import jax
+
+        h = hashlib.sha1()
+        for leaf in jax.tree_util.tree_leaves(self._state):
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr)
+        return h.hexdigest()
+
     # -- shapes ------------------------------------------------------------
+
+    @property
+    def _pixel_dtype(self):
+        return np.uint8 if self.ingest == "uint8" else np.float32
 
     def _zeros_batch(self, bucket: int, shots: int):
         n = self.cfg.num_classes_per_set
         t = self.cfg.num_target_samples
         h, w, c = self.cfg.im_shape
         return (
-            np.zeros((bucket, n, shots, h, w, c), np.float32),
+            np.zeros((bucket, n, shots, h, w, c), self._pixel_dtype),
             np.zeros((bucket, n, shots), np.int32),
-            np.zeros((bucket, n, t, h, w, c), np.float32),
+            np.zeros((bucket, n, t, h, w, c), self._pixel_dtype),
             np.zeros((bucket, n, t), np.int32),
         )
 
+    def _fast_template(self) -> Dict[str, Any]:
+        """Shapes/dtypes of ONE tenant's fast weights (the adapted subset
+        of ``state.net`` — ``core.partition.split_inner``)."""
+        from ..core import partition
+
+        adapted, _ = partition.split_inner(self.cfg, self._state.net)
+        return {
+            k: (tuple(v.shape), np.dtype(v.dtype)) for k, v in adapted.items()
+        }
+
     def _validate(self, req) -> int:
-        """Check one request against the engine geometry; returns its
-        shots count."""
+        """Check one request against the engine geometry + ingest tier;
+        returns its shots count."""
         n = self.cfg.num_classes_per_set
         t = self.cfg.num_target_samples
+        if self.ingest == "index":
+            return self._validate_index(req, n, t)
         h, w, c = self.cfg.im_shape
         sx = np.asarray(req.support_x)
         if sx.ndim != 5 or sx.shape[0] != n or sx.shape[2:] != (h, w, c):
             raise ValueError(
                 f"support_x must be ({n}, shots, {h}, {w}, {c}), got "
                 f"{sx.shape}"
+            )
+        qx = np.asarray(req.query_x)
+        if self.ingest == "uint8" and not (
+            sx.dtype == np.uint8 and qx.dtype == np.uint8
+        ):
+            # silent float->uint8 casting would corrupt pixels; the uint8
+            # tier's contract is RAW ENCODED pixels, decoded on device
+            raise ValueError(
+                f"ingest='uint8' requires uint8 support_x/query_x, got "
+                f"{sx.dtype}/{qx.dtype}"
             )
         shots = int(sx.shape[1])
         if shots not in self.shots_buckets:
@@ -253,7 +417,6 @@ class ServingEngine:
                 f"support_y must be ({n}, {shots}), got "
                 f"{np.asarray(req.support_y).shape}"
             )
-        qx = np.asarray(req.query_x)
         if qx.shape != (n, t, h, w, c):
             raise ValueError(
                 f"query_x must be ({n}, {t}, {h}, {w}, {c}), got {qx.shape}"
@@ -267,29 +430,231 @@ class ServingEngine:
             )
         return shots
 
-    # -- compile management ------------------------------------------------
+    def _validate_index(self, req, n: int, t: int) -> int:
+        si = np.asarray(getattr(req, "support_idx", None))
+        qi = np.asarray(getattr(req, "query_idx", None))
+        if si.dtype == object or si.ndim != 2 or si.shape[0] != n:
+            raise ValueError(
+                f"ingest='index' requires IndexRequest support_idx of "
+                f"shape ({n}, shots), got {getattr(req, 'support_idx', None)!r}"
+            )
+        shots = int(si.shape[1])
+        if shots not in self.shots_buckets:
+            raise ValueError(
+                f"request shots={shots} not in the engine's shots buckets "
+                f"{self.shots_buckets} (shots are never padded — they "
+                "enter the adaptation loss)"
+            )
+        if qi.dtype == object or qi.shape != (n, t):
+            raise ValueError(
+                f"query_idx must be ({n}, {t}), got "
+                f"{getattr(req, 'query_idx', None)!r}"
+            )
+        for name, arr in (("support_idx", si), ("query_idx", qi)):
+            if not np.issubdtype(arr.dtype, np.integer):
+                raise ValueError(f"{name} must be integer store rows")
+            if arr.size and (
+                int(arr.min()) < 0 or int(arr.max()) >= self._store_rows
+            ):
+                raise ValueError(
+                    f"{name} rows out of range [0, {self._store_rows}) "
+                    f"for the registered store"
+                )
+        return shots
 
-    def _site(self, bucket: int, shots: int) -> str:
-        return f"serve_step[b={bucket},s={shots}]"
+    # -- program table -----------------------------------------------------
 
-    def warmup(self) -> float:
-        """Compile (and run once, on zeros) every (bucket, shots) program.
+    def _site(self, family: str, bucket: int, shots: int) -> str:
+        if family == "predict":
+            return f"predict_step[i={self.ingest},b={bucket}]"
+        return f"serve_step[i={self.ingest},b={bucket},s={shots}]"
+
+    def _abstract(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype), tree
+        )
+
+    def _program_spec(self, family: str, bucket: int, shots: int):
+        """(traceable fn, donate argnums, abstract args) for one program
+        table entry — the single source of the serving program family."""
+        import jax
+
+        from ..core import maml
+
+        n = self.cfg.num_classes_per_set
+        t = self.cfg.num_target_samples
+        cache_on = self.cache_size > 0
+        state_sds = self._abstract(self._state)
+        if family == "adapt":
+            if self.ingest == "index":
+                fn = maml.make_serve_step_indexed(
+                    self.cfg, shots, return_adapted=cache_on
+                )
+                args = (
+                    state_sds,
+                    self._abstract(self._store),
+                    jax.ShapeDtypeStruct((bucket, n, shots + t), np.int32),
+                    jax.ShapeDtypeStruct((bucket,), np.float32),
+                )
+            else:
+                fn = maml.make_serve_step(
+                    self.cfg, self.ingest, return_adapted=cache_on
+                )
+                args = (
+                    state_sds,
+                    *self._abstract(self._zeros_batch(bucket, shots)),
+                    jax.ShapeDtypeStruct((bucket,), np.float32),
+                )
+            return fn, maml.SERVE_DONATE, args
+        fast_sds = {
+            k: jax.ShapeDtypeStruct((bucket,) + shape, dtype)
+            for k, (shape, dtype) in self._fast_template().items()
+        }
+        if self.ingest == "index":
+            fn = maml.make_predict_step_indexed(self.cfg)
+            args = (
+                state_sds,
+                fast_sds,
+                self._abstract(self._store),
+                jax.ShapeDtypeStruct((bucket, n, t), np.int32),
+                jax.ShapeDtypeStruct((bucket,), np.float32),
+            )
+        else:
+            h, w, c = self.cfg.im_shape
+            fn = maml.make_predict_step(self.cfg, self.ingest)
+            args = (
+                state_sds,
+                fast_sds,
+                jax.ShapeDtypeStruct(
+                    (bucket, n, t, h, w, c), self._pixel_dtype
+                ),
+                jax.ShapeDtypeStruct((bucket, n, t), np.int32),
+                jax.ShapeDtypeStruct((bucket,), np.float32),
+            )
+        return fn, maml.PREDICT_DONATE, args
+
+    def _build_program(self, family: str, bucket: int, shots: int):
+        import jax
+
+        fn, donate, args = self._program_spec(family, bucket, shots)
+        return jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+
+    def _program(self, family: str, bucket: int, shots: int):
+        key = (family, bucket, shots)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._build_program(family, bucket, shots)
+            self._programs[key] = prog
+        return prog
+
+    def _program_names(self) -> Dict[str, Tuple[str, int, int]]:
+        """Artifact name -> program-table key, for every program this
+        engine can dispatch (the export/warmup ladder)."""
+        names: Dict[str, Tuple[str, int, int]] = {}
+        for shots in self.shots_buckets:
+            for bucket in self.buckets:
+                names[f"adapt_b{bucket}_s{shots}"] = ("adapt", bucket, shots)
+        if self.cache_size > 0:
+            for bucket in self.buckets:
+                names[f"predict_b{bucket}"] = ("predict", bucket, 0)
+        return names
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, artifact_dir: Optional[str] = None) -> float:
+        """Materialize (and run once, on zeros) every serving program.
 
         Returns the wall seconds spent — the whole compile bill of the
         engine: after this, steady-state traffic of ANY mix of bucket
         sizes and configured shots dispatches with zero retraces (the
-        strict detector enforces it). With a persistent compilation cache
-        enabled the compiles warm-start from disk.
+        strict detector enforces it).
+
+        ``artifact_dir`` (default: ``cfg.serving_export_dir``) switches
+        warmup to the AOT-artifact path: previously exported executables
+        (``serving/export.py`` / ``cli serve-export``) are DESERIALIZED —
+        zero XLA compilations — and any mismatch (device kind, dtype,
+        config fingerprint, jax version, ladder, ingest, cache flag,
+        index-store rows) falls back to compile-then-save, so the next
+        start loads. ``warmup_stats`` records the outcome: ``mode``
+        ('artifacts' | 'compile'), ``seconds``, ``xla_compiles`` (the
+        process-wide backend-compile delta — 0 on the artifact path) and
+        ``programs``; a telemetry event='warmup' record mirrors it.
+        With a persistent compilation cache enabled the compile path
+        itself warm-starts from disk.
         """
+        from . import export as export_lib
+
+        if artifact_dir is None:
+            artifact_dir = self.cfg.serving_export_dir or None
         start = time.perf_counter()
+        compiles0 = export_lib.xla_compile_count()
+        cache_on = self.cache_size > 0
+        names = self._program_names()
+        extra = (
+            {"store_rows": self._store_rows}
+            if self.ingest == "index" else None
+        )
+        mode = "compile"
+        if artifact_dir:
+            loaded = export_lib.load_artifacts(
+                self.cfg, artifact_dir, self.ingest, cache_on,
+                self.buckets, self.shots_buckets, extra,
+            )
+            if loaded is not None and set(loaded) >= set(names):
+                for name, key in names.items():
+                    self._programs[key] = loaded[name]
+                mode = "artifacts"
+        if mode == "compile":
+            for key in names.values():
+                self._program(*key)
+            if artifact_dir:
+                export_lib.save_artifacts(
+                    self.cfg, artifact_dir, self.ingest, cache_on,
+                    self.buckets, self.shots_buckets,
+                    {name: self._programs[key]
+                     for name, key in names.items()},
+                    extra,
+                )
+        # execute each program once on zeros: proves it dispatches, warms
+        # the allocator, and primes the retrace detector's sites
         for shots in self.shots_buckets:
             for bucket in self.buckets:
                 x_s, y_s, x_t, y_t = self._zeros_batch(bucket, shots)
                 valid = np.zeros(bucket, np.float32)
-                self._dispatch(bucket, shots, x_s, y_s, x_t, y_t, valid)
-        return time.perf_counter() - start
+                if self.ingest == "index":
+                    n = self.cfg.num_classes_per_set
+                    t = self.cfg.num_target_samples
+                    gather = np.zeros((bucket, n, shots + t), np.int32)
+                    args = (self._state, self._store, gather, valid)
+                else:
+                    args = (self._state, x_s, y_s, x_t, y_t, valid)
+                self._raw_dispatch("adapt", bucket, shots, args)
+        if cache_on:
+            for bucket in self.buckets:
+                self._raw_dispatch(
+                    "predict", bucket, 0,
+                    self._predict_args([], [], bucket),
+                )
+        seconds = time.perf_counter() - start
+        self.warmup_stats = {
+            "mode": mode,
+            "seconds": round(seconds, 3),
+            "xla_compiles": export_lib.xla_compile_count() - compiles0,
+            "programs": len(names),
+        }
+        self._record(
+            event="warmup", mode=mode,
+            warmup_ms=round(seconds * 1e3, 3),
+            xla_compiles=self.warmup_stats["xla_compiles"],
+            programs=len(names), ingest=self.ingest,
+        )
+        return seconds
 
-    def _dispatch(self, bucket, shots, x_s, y_s, x_t, y_t, valid):
+    # -- dispatch ----------------------------------------------------------
+
+    def _raw_dispatch(self, family: str, bucket: int, shots: int, args):
         """One device dispatch; returns (out, adapt_ms). ``adapt_ms`` is
         enqueue-to-host-fetch: it includes the H2D upload and the result
         readback — the latency a caller actually observes.
@@ -306,17 +671,16 @@ class ServingEngine:
                 "the state was donated (root cause chained below); build "
                 "a fresh engine from the snapshot"
             ) from self._dead
+        prog = self._program(family, bucket, shots)
         self.retrace_detector.observe(
-            self._site(bucket, shots), (self._state, x_s, y_s, x_t, y_t, valid)
+            self._site(family, bucket, shots), args
         )
         start = time.perf_counter()
         try:
-            new_state, out = self._step(
-                self._state, x_s, y_s, x_t, y_t, valid
-            )
+            new_state, out = prog(*args)
             # host-fetch every output the caller reads: the one sync that
             # provably blocks on every backend (see bench.py's sync note)
-            out = {
+            fetched = {
                 "preds": np.asarray(out["preds"]),
                 "loss": np.asarray(out["loss"]),
                 "accuracy": np.asarray(out["accuracy"]),
@@ -325,6 +689,10 @@ class ServingEngine:
                     for k, v in out["metrics"].items()
                 },
             }
+            if "adapted" in out:
+                fetched["adapted"] = {
+                    k: np.asarray(v) for k, v in out["adapted"].items()
+                }
         except BaseException as e:
             self._dead = e
             raise
@@ -332,18 +700,130 @@ class ServingEngine:
         # re-bind: the old state buffers were donated to (and alias) the
         # returned state — the previous reference is dead
         self._state = new_state
-        return out, adapt_ms
+        return fetched, adapt_ms
+
+    def _adapt_args(self, requests, bucket: int, shots: int):
+        """Assemble one adapt dispatch's args for this ingest tier."""
+        n = self.cfg.num_classes_per_set
+        t = self.cfg.num_target_samples
+        valid = np.zeros(bucket, np.float32)
+        if self.ingest == "index":
+            gather = np.zeros((bucket, n, shots + t), np.int32)
+            for i, req in enumerate(requests):
+                gather[i, :, :shots] = np.asarray(req.support_idx, np.int32)
+                gather[i, :, shots:] = np.asarray(req.query_idx, np.int32)
+                if req.labeled:
+                    valid[i] = 1.0
+            return (self._state, self._store, gather, valid)
+        dtype = self._pixel_dtype
+        x_s, y_s, x_t, y_t = self._zeros_batch(bucket, shots)
+        for i, req in enumerate(requests):
+            x_s[i] = np.asarray(req.support_x, dtype)
+            y_s[i] = np.asarray(req.support_y, np.int32)
+            x_t[i] = np.asarray(req.query_x, dtype)
+            if req.query_y is not None:
+                y_t[i] = np.asarray(req.query_y, np.int32)
+                # the metric mask admits LABELED tenants only: a
+                # label-free tenant's y_t slot is fabricated zeros, and
+                # scoring it would poison the aggregate (its predictions
+                # don't read labels and are unaffected)
+                valid[i] = 1.0
+        return (self._state, x_s, y_s, x_t, y_t, valid)
+
+    def _predict_args(self, fasts, requests, bucket: int):
+        """Assemble one predict (cache-hit) dispatch's args; ``fasts`` is
+        the per-tenant cached fast-weight list aligned with ``requests``
+        (both may be empty: warmup's zeros dispatch)."""
+        n = self.cfg.num_classes_per_set
+        t = self.cfg.num_target_samples
+        template = self._fast_template()
+        fast = {
+            k: np.zeros((bucket,) + shape, dtype)
+            for k, (shape, dtype) in template.items()
+        }
+        for i, fw in enumerate(fasts):
+            for k in fast:
+                fast[k][i] = fw[k]
+        valid = np.zeros(bucket, np.float32)
+        if self.ingest == "index":
+            gather = np.zeros((bucket, n, t), np.int32)
+            for i, req in enumerate(requests):
+                gather[i] = np.asarray(req.query_idx, np.int32)
+                if req.labeled:
+                    valid[i] = 1.0
+            return (self._state, fast, self._store, gather, valid)
+        h, w, c = self.cfg.im_shape
+        x_t = np.zeros((bucket, n, t, h, w, c), self._pixel_dtype)
+        y_t = np.zeros((bucket, n, t), np.int32)
+        for i, req in enumerate(requests):
+            x_t[i] = np.asarray(req.query_x, self._pixel_dtype)
+            if req.query_y is not None:
+                y_t[i] = np.asarray(req.query_y, np.int32)
+                valid[i] = 1.0
+        return (self._state, fast, x_t, y_t, valid)
+
+    @staticmethod
+    def _args_h2d_bytes(args) -> int:
+        """Actual H2D payload of a dispatch: every HOST (numpy) argument
+        uploads; device-resident args (the donated state, the registered
+        store) do not."""
+        total = 0
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(args):
+            if isinstance(leaf, np.ndarray):
+                total += int(leaf.nbytes)
+        return total
+
+    def _labeled_of(self, req) -> bool:
+        if self.ingest == "index":
+            return bool(req.labeled)
+        return req.query_y is not None
+
+    # -- the adapted-params cache ------------------------------------------
+
+    def _cache_key(self, req, shots: int) -> str:
+        """Tenant support-set fingerprint: content hash + shots +
+        snapshot id (the salt). A changed support set, shots count,
+        checkpoint, ingest tier or registered store produces a different
+        key by construction."""
+        h = hashlib.sha1(self._cache_salt)
+        h.update(str(shots).encode())
+        if self.ingest == "index":
+            si = np.ascontiguousarray(np.asarray(req.support_idx, np.int64))
+            h.update(str(si.shape).encode())
+            h.update(si)
+        else:
+            sx = np.ascontiguousarray(np.asarray(req.support_x))
+            sy = np.ascontiguousarray(np.asarray(req.support_y, np.int64))
+            h.update(str(sx.shape).encode())
+            h.update(str(sx.dtype).encode())
+            h.update(sx)
+            h.update(sy)
+        return h.hexdigest()
+
+    def _cache_insert(self, key: str, fast: Dict[str, np.ndarray]) -> None:
+        self._cache[key] = fast
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.cache_size:
+            self._cache.popitem(last=False)
 
     # -- serving -----------------------------------------------------------
 
     def serve_group(self, requests: Sequence[Any],
                     queue_ms: float = 0.0) -> DispatchResult:
-        """Serve one group of same-shots requests as ONE padded dispatch.
+        """Serve one group of same-shots requests.
 
         The group must fit ``serving_max_tenants_per_dispatch`` (the
         batcher's job); pad tenants up to the bucket are zeros, masked
         out of the aggregate metrics and — by vmap independence —
         incapable of touching real tenants' outputs.
+
+        With the adapted-params cache on, the group splits into cache
+        MISSES (full adapt dispatch, whose per-tenant fast weights are
+        inserted into the LRU) and HITS (predict-only dispatch over the
+        cached fast weights — no inner loop); results come back aligned
+        with the input order regardless of the split.
         """
         if not requests:
             raise ValueError("serve_group needs at least one request")
@@ -359,48 +839,106 @@ class ServingEngine:
             )
         shots = shots_set.pop()
         n_real = len(requests)
-        bucket = _bucket_for(n_real, self.buckets)
-        x_s, y_s, x_t, y_t = self._zeros_batch(bucket, shots)
-        valid = np.zeros(bucket, np.float32)
-        labeled = np.zeros(n_real, bool)
-        for i, req in enumerate(requests):
-            x_s[i] = np.asarray(req.support_x, np.float32)
-            y_s[i] = np.asarray(req.support_y, np.int32)
-            x_t[i] = np.asarray(req.query_x, np.float32)
-            if req.query_y is not None:
-                y_t[i] = np.asarray(req.query_y, np.int32)
-                labeled[i] = True
-                # the metric mask admits LABELED tenants only: a
-                # label-free tenant's y_t slot is fabricated zeros, and
-                # scoring it would poison the aggregate (its predictions
-                # don't read labels and are unaffected)
-                valid[i] = 1.0
+        cache_on = self.cache_size > 0
+        keys: List[Optional[str]] = [None] * n_real
+        hit_idx: List[int] = []
+        hit_fasts: List[Dict[str, np.ndarray]] = []
+        miss_idx: List[int] = list(range(n_real))
+        if cache_on:
+            keys = [self._cache_key(r, shots) for r in requests]
+            hit_idx, miss_idx = [], []
+            for i, key in enumerate(keys):
+                if key in self._cache:
+                    self._cache.move_to_end(key)
+                    hit_idx.append(i)
+                    # snapshot the fast weights NOW: inserting this
+                    # group's misses below may evict the hit entries
+                    # from a small LRU before the predict dispatch reads
+                    # them (entries are immutable once inserted, so the
+                    # reference stays valid past eviction)
+                    hit_fasts.append(self._cache[key])
+                else:
+                    miss_idx.append(i)
+            self.cache_hits += len(hit_idx)
+            self.cache_misses += len(miss_idx)
         if self._span_start is None:
             self._span_start = time.perf_counter()
-        out, adapt_ms = self._dispatch(
-            bucket, shots, x_s, y_s, x_t, y_t, valid
-        )
-        self._span_end = time.perf_counter()
-        results = [
-            TenantResult(
-                tenant_id=getattr(req, "tenant_id", None),
-                preds=out["preds"][i],
-                loss=float(out["loss"][i]) if labeled[i] else None,
-                accuracy=float(out["accuracy"][i]) if labeled[i] else None,
+        results: List[Optional[TenantResult]] = [None] * n_real
+        total_ms = 0.0
+        total_h2d = 0
+        metric_parts: List[Tuple[Dict[str, float], int]] = []
+        bucket: Optional[int] = None
+
+        def _fill(idxs, out, adapt_ms, args, program, dispatch_bucket):
+            nonlocal total_ms, total_h2d, bucket
+            h2d = self._args_h2d_bytes(args)
+            total_ms += adapt_ms
+            total_h2d += h2d
+            if bucket is None or program == "adapt":
+                bucket = dispatch_bucket
+            labeled_count = 0
+            for j, i in enumerate(idxs):
+                req = requests[i]
+                lab = self._labeled_of(req)
+                labeled_count += int(lab)
+                results[i] = TenantResult(
+                    tenant_id=getattr(req, "tenant_id", None),
+                    preds=out["preds"][j],
+                    loss=float(out["loss"][j]) if lab else None,
+                    accuracy=float(out["accuracy"][j]) if lab else None,
+                )
+            metric_parts.append((out["metrics"], labeled_count))
+            self._adapt_ms.append(adapt_ms)
+            self._h2d_bytes.append(h2d)
+            self._record(
+                event="dispatch", tenants=len(idxs),
+                bucket=dispatch_bucket, shots=shots,
+                queue_ms=round(float(queue_ms), 3),
+                adapt_ms=round(adapt_ms, 3), program=program,
+                ingest=self.ingest, ingest_bytes=h2d,
+                cache_hits=len(idxs) if program == "predict" else 0,
             )
-            for i, req in enumerate(requests)
-        ]
-        self._adapt_ms.append(adapt_ms)
+
+        if miss_idx:
+            group = [requests[i] for i in miss_idx]
+            b = _bucket_for(len(group), self.buckets)
+            args = self._adapt_args(group, b, shots)
+            out, adapt_ms = self._raw_dispatch("adapt", b, shots, args)
+            if cache_on and "adapted" in out:
+                for j, i in enumerate(miss_idx):
+                    self._cache_insert(
+                        keys[i],
+                        {k: np.array(v[j])
+                         for k, v in out["adapted"].items()},
+                    )
+            _fill(miss_idx, out, adapt_ms, args, "adapt", b)
+        if hit_idx:
+            group = [requests[i] for i in hit_idx]
+            b = _bucket_for(len(group), self.buckets)
+            args = self._predict_args(hit_fasts, group, b)
+            out, adapt_ms = self._raw_dispatch("predict", b, 0, args)
+            _fill(hit_idx, out, adapt_ms, args, "predict", b)
+        self._span_end = time.perf_counter()
         self._queue_ms.append(float(queue_ms))
         self._tenants_served += n_real
-        self._record(
-            event="dispatch", tenants=n_real, bucket=bucket, shots=shots,
-            queue_ms=round(float(queue_ms), 3), adapt_ms=round(adapt_ms, 3),
-        )
+        # combine the per-dispatch masked means, weighted by how many
+        # LABELED tenants each dispatch carried (each mean is already
+        # over its labeled tenants only)
+        total_labeled = sum(nlab for _, nlab in metric_parts)
+        if total_labeled:
+            metrics = {
+                key: sum(m[key] * nlab for m, nlab in metric_parts)
+                / total_labeled
+                for key in ("loss", "accuracy")
+            }
+        else:
+            metrics = {"loss": 0.0, "accuracy": 0.0}
         return DispatchResult(
-            results=results, tenants=n_real, bucket=bucket, shots=shots,
-            queue_ms=float(queue_ms), adapt_ms=adapt_ms,
-            metrics=out["metrics"],
+            results=results, tenants=n_real,
+            bucket=int(bucket), shots=shots,
+            queue_ms=float(queue_ms), adapt_ms=total_ms,
+            metrics=metrics, cache_hits=len(hit_idx),
+            ingest_bytes=total_h2d,
         )
 
     # -- telemetry ---------------------------------------------------------
@@ -421,18 +959,24 @@ class ServingEngine:
         last one's end — the closed-loop number, and the ONE definition
         of this metric (serve-bench and bench.py report it verbatim); an
         open-loop server's throughput is additionally bounded by arrival
-        rate."""
+        rate. ``h2d_bytes_per_dispatch`` is the windowed mean of actual
+        uploaded bytes (the ingest tier's acceptance metric);
+        ``cache_hit_rate`` is lifetime hits over lookups (None when the
+        adapted-params cache is off)."""
         adapt = np.asarray(self._adapt_ms, np.float64)
         queue = np.asarray(self._queue_ms, np.float64)
+        h2d = np.asarray(self._h2d_bytes, np.float64)
         span_s = (
             self._span_end - self._span_start
             if self._span_start is not None and self._span_end is not None
             else 0.0
         )
+        lookups = self.cache_hits + self.cache_misses
         out: Dict[str, Any] = {
             "dispatches": int(adapt.size),
             "tenants": int(self._tenants_served),
             "retraces": int(self.retrace_detector.retrace_count),
+            "ingest": self.ingest,
             "adapt_ms_p50": (
                 round(float(np.percentile(adapt, 50)), 3) if adapt.size
                 else None
@@ -449,6 +993,13 @@ class ServingEngine:
                 round(self._tenants_served / span_s, 3)
                 if span_s > 0
                 else None
+            ),
+            "h2d_bytes_per_dispatch": (
+                round(float(np.mean(h2d)), 1) if h2d.size else None
+            ),
+            "cache_hit_rate": (
+                round(self.cache_hits / lookups, 4)
+                if self.cache_size > 0 and lookups else None
             ),
         }
         self._record(event="rollup", **out)

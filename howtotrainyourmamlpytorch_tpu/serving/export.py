@@ -1,0 +1,306 @@
+"""AOT export artifacts for the serving engine (``cli serve-export``).
+
+``ServingEngine.warmup()`` is the whole compile bill of a serving
+replica: every (bucket, shots) program — multi-second XLA compiles on a
+TPU, per process, per restart. The persistent compilation cache already
+amortizes the *XLA* half across processes, but the engine still pays the
+trace/lower path and the cache is best-effort. This module makes cold
+starts a **deserialize**: the warmed program ladder is serialized with
+``jax.experimental.serialize_executable`` (the loaded-executable form of
+``jax.export`` — the compiled artifact itself, not just StableHLO, which
+is what makes a zero-XLA-compile warmup possible) into a versioned
+artifact directory, and ``warmup()`` loads it back before falling back
+to compile-then-save.
+
+Artifact layout::
+
+    <root>/<device_kind>-<dtype>-<config_fingerprint[:12]>/
+        MANIFEST.json          # the compatibility key (see below)
+        adapt_b2_s1.bin        # one serialized executable per program
+        predict_b2.bin         # (cache-enabled engines only)
+
+Compatibility is FINGERPRINTED, not assumed: the manifest records the
+jax version, backend, device kind, compute dtype, the config
+fingerprint (``analysis.contracts.config_fingerprint`` — any geometry or
+lowering knob change invalidates), the ingest mode, the cache flag and
+the (bucket, shots) ladder. ``load_artifacts`` returns None on ANY
+mismatch — a stale or foreign artifact dir silently degrades to the
+compile path, never to a wrong program. Executables are device-kind
+specific by nature (the key encodes it); artifacts are local build
+products like the XLA cache, not a portable interchange format (the
+``.bin`` payload embeds pickled pytree metadata — load only artifact
+dirs you wrote).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+#: bump when the artifact layout or payload format changes
+ARTIFACT_VERSION = 1
+
+_compile_events = [0]
+_listener_installed = [False]
+
+
+def install_compile_counter() -> None:
+    """Count XLA backend compiles process-wide (idempotent).
+
+    Registers a ``jax.monitoring`` duration listener on the
+    ``backend_compile`` event — the hook every XLA compile fires — so the
+    engine can assert its warmup-from-artifacts path really performed
+    zero compiles (the acceptance surface of the export tier).
+    """
+    if _listener_installed[0]:
+        return
+    import jax
+
+    def _listener(event: str, duration: float, **kw: Any) -> None:
+        if "backend_compile" in event:
+            _compile_events[0] += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+    _listener_installed[0] = True
+
+
+def xla_compile_count() -> int:
+    """XLA backend compiles observed since ``install_compile_counter``."""
+    return _compile_events[0]
+
+
+def config_fingerprint(cfg) -> str:
+    """The serving config's compatibility fingerprint (the same
+    ``analysis.contracts`` digest the program-contract baseline pins)."""
+    from ..analysis.contracts import config_fingerprint as fp
+
+    return fp(dataclasses.asdict(cfg))
+
+
+def artifact_dir_for(cfg, root: str, ingest: str = "f32",
+                     cache: bool = False) -> str:
+    """The versioned artifact subdirectory for this (device kind, dtype,
+    config, ingest, cache-flag) point under ``root``. Ingest and the
+    cache flag are ENGINE-level settings that select different program
+    families without changing the config fingerprint, so they key the
+    directory too — engines in different modes sharing one export root
+    must coexist, not clobber each other's artifacts."""
+    import jax
+
+    device_kind = jax.devices()[0].device_kind.replace(" ", "_")
+    suffix = f"-{ingest}" + ("-cache" if cache else "")
+    return os.path.join(
+        root,
+        f"{device_kind}-{cfg.compute_dtype}-"
+        f"{config_fingerprint(cfg)[:12]}{suffix}",
+    )
+
+
+def _manifest_expectation(cfg, ingest: str, cache: bool,
+                          buckets, shots_buckets,
+                          extra: Optional[Dict[str, Any]] = None
+                          ) -> Dict[str, Any]:
+    import jax
+
+    out = {
+        "artifact_version": ARTIFACT_VERSION,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "compute_dtype": cfg.compute_dtype,
+        "config_fingerprint": config_fingerprint(cfg),
+        "ingest": ingest,
+        "cache": bool(cache),
+        "bucket_ladder": [int(b) for b in buckets],
+        "shots_buckets": [int(s) for s in shots_buckets],
+    }
+    # ingest-specific compatibility keys (e.g. the index ingest's resident
+    # store row count — baked into the gather program's shapes)
+    out.update(extra or {})
+    return out
+
+
+def save_artifacts(
+    cfg,
+    root: str,
+    ingest: str,
+    cache: bool,
+    buckets,
+    shots_buckets,
+    programs: Dict[str, Any],
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Serialize every compiled program in ``programs`` (name ->
+    ``jax.stages.Compiled``) under the versioned artifact dir; returns
+    the dir. Writes are temp + ``os.replace`` (the repo's crash-safe
+    file discipline), the manifest last — a killed export is rebuilt,
+    never half-loaded."""
+    from jax.experimental import serialize_executable
+
+    out_dir = artifact_dir_for(cfg, root, ingest, cache)
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = _manifest_expectation(
+        cfg, ingest, cache, buckets, shots_buckets, extra
+    )
+    manifest["programs"] = {}
+    for name, compiled in programs.items():
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        fname = f"{name}.bin"
+        path = os.path.join(out_dir, fname)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump((ARTIFACT_VERSION, payload, in_tree, out_tree), f)
+        os.replace(tmp, path)
+        manifest["programs"][name] = fname
+    mpath = os.path.join(out_dir, "MANIFEST.json")
+    mtmp = f"{mpath}.tmp.{os.getpid()}"
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(mtmp, mpath)
+    return out_dir
+
+
+def load_artifacts(
+    cfg,
+    root: str,
+    ingest: str,
+    cache: bool,
+    buckets,
+    shots_buckets,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Optional[Dict[str, Callable[..., Any]]]:
+    """Load the program ladder from ``root`` when (and only when) the
+    manifest matches this engine exactly; returns name -> loaded
+    executable, or None on any mismatch/absence (the caller falls back
+    to compile-then-save). Loading performs ZERO XLA compilations — the
+    payload is the compiled executable."""
+    from jax.experimental import serialize_executable
+
+    out_dir = artifact_dir_for(cfg, root, ingest, cache)
+    mpath = os.path.join(out_dir, "MANIFEST.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    expected = _manifest_expectation(
+        cfg, ingest, cache, buckets, shots_buckets, extra
+    )
+    if any(manifest.get(k) != v for k, v in expected.items()):
+        return None
+    programs: Dict[str, Callable[..., Any]] = {}
+    for name, fname in manifest.get("programs", {}).items():
+        try:
+            with open(os.path.join(out_dir, fname), "rb") as f:
+                version, payload, in_tree, out_tree = pickle.load(f)
+        except (OSError, pickle.PickleError, ValueError, EOFError):
+            return None
+        if version != ARTIFACT_VERSION:
+            return None
+        programs[name] = serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree
+        )
+    return programs or None
+
+
+# -- cli serve-export ---------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """``cli serve-export`` — write the warmed serving program ladder as
+    AOT artifacts a later engine start deserializes instead of compiling.
+
+    Shares ``serve-bench``'s config construction (``--fast`` /
+    ``--config`` / ``--checkpoint``) so an exported ladder's fingerprint
+    matches the engine the bench (or a production replica with the same
+    experiment JSON) builds.
+    """
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(
+        prog="serve-export",
+        description="AOT-export the serving engine's warmed (bucket x "
+                    "shots) program ladder to a versioned artifact dir "
+                    "ServingEngine.warmup() loads without compiling",
+    )
+    parser.add_argument("--out", required=True, metavar="DIR",
+                        help="artifact root directory (the versioned "
+                             "device-kind/dtype/fingerprint subdir is "
+                             "created under it)")
+    parser.add_argument("--fast", action="store_true",
+                        help="the serve-bench --fast config (the CI gate)")
+    parser.add_argument("--config", default=None,
+                        help="experiment JSON supplying the geometry and "
+                             "serving_* knobs")
+    parser.add_argument("--checkpoint", default=None, metavar="DIR",
+                        help="export against this saved_models "
+                             "checkpoint's snapshot (read-only restore; "
+                             "requires --config, like serve-bench)")
+    parser.add_argument("--model-idx", default="latest")
+    parser.add_argument("--ingest", default=None,
+                        choices=["f32", "uint8"],
+                        help="ingest tier to export programs for "
+                             "(default: the config's serving_ingest). "
+                             "The index ingest's programs bake the "
+                             "resident store's row count into their "
+                             "shapes, so those artifacts are written by "
+                             "the ENGINE's compile-then-save fallback at "
+                             "first warmup against the real store, not "
+                             "by this store-less CLI")
+    parser.add_argument("--cache", action="store_true",
+                        help="also export the adapted-params-cache "
+                             "family (return-adapted serve + predict "
+                             "programs)")
+    args = parser.parse_args(argv)
+    if args.checkpoint and not args.config:
+        parser.error("--checkpoint requires --config (see serve-bench)")
+
+    from ..core import maml
+    from .bench import _bench_cfg, bench_shots_buckets
+    from .engine import ServingEngine, load_servable_snapshot
+
+    cfg = _bench_cfg(args)
+    if args.checkpoint:
+        state, _ = load_servable_snapshot(cfg, args.checkpoint, args.model_idx)
+    else:
+        state = maml.init_state(cfg)
+    ingest = args.ingest or cfg.serving_ingest
+    if ingest == "index":
+        parser.error(
+            "serve-export cannot export index-ingest programs: their "
+            "shapes bake in the resident store's row count; point the "
+            "engine at the artifact dir instead (warmup falls back to "
+            "compile-then-save against the real store)"
+        )
+    cache_size = cfg.serving_adapted_cache_size
+    if args.cache and cache_size == 0:
+        cache_size = cfg.serving_max_tenants_per_dispatch
+    engine = ServingEngine(
+        cfg, state, shots_buckets=bench_shots_buckets(cfg),
+        ingest=ingest, cache_size=cache_size,
+    )
+    start = time.perf_counter()
+    engine.warmup(artifact_dir=args.out)
+    stats = dict(engine.warmup_stats)
+    out_dir = artifact_dir_for(cfg, args.out, ingest, cache_size > 0)
+    line = {
+        "artifact_dir": out_dir,
+        "programs": stats.get("programs"),
+        "mode": stats.get("mode"),
+        "warmup_seconds": round(time.perf_counter() - start, 3),
+        "xla_compiles": stats.get("xla_compiles"),
+        "ingest": ingest,
+        "cache": cache_size > 0,
+    }
+    print(json.dumps(line))
+    return 0 if os.path.exists(os.path.join(out_dir, "MANIFEST.json")) else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

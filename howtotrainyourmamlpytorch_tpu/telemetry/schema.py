@@ -70,14 +70,21 @@ Record kinds:
   ``episode_cursor`` re-entry point) — so a pod-scale preemption or a
   topology-changing resume documents itself in the run's own log;
 * ``serving``        — the adapt-on-request serving engine (serving/,
-  schema v8): ``event`` names the record shape — ``dispatch`` (one
-  multi-tenant serving dispatch: real ``tenants``, the padded
-  ``bucket`` and ``shots`` point it rode, host ``queue_ms`` in the
-  micro-batcher and end-to-end ``adapt_ms`` device latency) or
-  ``rollup`` (the run condensed: dispatch/tenant counts,
-  ``adapt_ms_p50`` / ``adapt_ms_p95``, ``tenants_per_sec``, and the
-  strict retrace count — 0 in any healthy run). The ``serving:`` line
-  of ``cli inspect summary`` renders these jax-free;
+  schema v8; extended in v9): ``event`` names the record shape —
+  ``dispatch`` (one multi-tenant serving dispatch: real ``tenants``,
+  the padded ``bucket`` and ``shots`` point it rode, host ``queue_ms``
+  in the micro-batcher and end-to-end ``adapt_ms`` device latency;
+  since v9 also the fast-path fields: ``program`` ('adapt' |
+  'predict'), ``ingest`` ('f32' | 'uint8' | 'index'), ``ingest_bytes``
+  — the dispatch's actual H2D payload — and ``cache_hits``), ``warmup``
+  (since v9: how the engine warmed — ``mode`` 'artifacts' (AOT
+  export deserialize) or 'compile', ``warmup_ms``, ``xla_compiles`` —
+  0 on the artifact path — and ``programs``) or ``rollup`` (the run
+  condensed: dispatch/tenant counts, ``adapt_ms_p50`` /
+  ``adapt_ms_p95``, ``tenants_per_sec``, the strict retrace count — 0
+  in any healthy run — and since v9 ``h2d_bytes_per_dispatch`` and
+  ``cache_hit_rate``). The ``serving:`` line of ``cli inspect
+  summary`` renders these jax-free;
 * ``analysis``       — the build-time program audit ran
   (``analysis_level != 'off'``): how many programs were audited (incl.
   the SPMD family on multi-device builds), how many contract violations
@@ -142,6 +149,17 @@ Version history / migration notes:
   record validates unchanged (``tests/fixtures/telemetry_v7_schema.jsonl``
   pins a v7-era log) and the forward-compat rules carry over (the
   future-schema fixture is re-pinned at v9-unknown).
+* **v9** — the ``serving`` record gains the fast-path fields: dispatch
+  records carry ``program`` / ``ingest`` / ``ingest_bytes`` /
+  ``cache_hits`` (the uint8/index ingest tiers and the adapted-params
+  cache), a new ``event='warmup'`` shape records export-artifact vs
+  compile warmups (``mode`` / ``warmup_ms`` / ``xla_compiles``), and
+  the rollup gains ``h2d_bytes_per_dispatch`` / ``cache_hit_rate``.
+  Pure addition — no new kinds, no new REQUIRED fields (``serving``
+  still requires only ``event``): every v1..v8 record validates
+  unchanged (``tests/fixtures/telemetry_v8_schema.jsonl`` pins a
+  v8-era log) and the forward-compat rules carry over (the
+  future-schema fixture is re-pinned at v10-unknown).
 """
 
 from __future__ import annotations
@@ -149,7 +167,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 

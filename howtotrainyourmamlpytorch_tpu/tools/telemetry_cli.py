@@ -200,6 +200,13 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
         ),
         "tenants_per_sec": (rollup or {}).get("tenants_per_sec"),
         "retraces": (rollup or {}).get("retraces"),
+        # the v9 fast-path fields (None on v8-era logs — the line simply
+        # omits them)
+        "ingest": (rollup or {}).get("ingest"),
+        "h2d_bytes_per_dispatch": (
+            (rollup or {}).get("h2d_bytes_per_dispatch")
+        ),
+        "cache_hit_rate": (rollup or {}).get("cache_hit_rate"),
     }
     return out
 
@@ -453,6 +460,12 @@ def cmd_summary(args) -> int:
             parts.append(f"queue {sv['queue_ms_mean']:.2f}ms")
         if sv.get("tenants_per_sec") is not None:
             parts.append(f"{sv['tenants_per_sec']:.1f} tenants/s")
+        if sv.get("ingest") is not None:
+            parts.append(f"ingest {sv['ingest']}")
+        if sv.get("h2d_bytes_per_dispatch") is not None:
+            parts.append(f"{sv['h2d_bytes_per_dispatch']:.0f} B/dispatch")
+        if sv.get("cache_hit_rate") is not None:
+            parts.append(f"cache hit {sv['cache_hit_rate']:.0%}")
         if sv.get("retraces"):
             parts.append(f"{sv['retraces']} RETRACE(S)")
         lines.append("  serving: " + ", ".join(parts))

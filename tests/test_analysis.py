@@ -54,6 +54,8 @@ def test_family_has_expected_programs(audit_reports):
         "eval_multi_step[k=2]",
         "index_expander",
         "serve_step[b=2]",
+        "serve_step_uint8[b=2]",
+        "predict_step[b=2]",
     }
 
 

@@ -128,7 +128,8 @@ def test_eval_programs_do_not_donate(audit_reports):
     separately below."""
     for r in audit_reports:
         if not r.program.startswith(
-            ("train_step", "train_multi_step", "serve_step")
+            ("train_step", "train_multi_step", "serve_step",
+             "predict_step")
         ):
             assert r.donation is None, r.program
 
@@ -144,11 +145,21 @@ def test_serve_step_donates_passthrough_state(audit_reports, micro_cfg):
         audit_lib._state_avals(micro_cfg)
     )
     serve = [r for r in audit_reports if r.program.startswith("serve_step")]
-    assert len(serve) == 1
-    r = serve[0]
+    assert len(serve) == 2  # the f32 and uint8 ingest variants
+    for r in serve:
+        assert [v for v in r.violations if v.contract == "donation"] == []
+        assert r.donation is not None, r.program
+        assert r.donation["donate_argnums"] == list(maml.SERVE_DONATE)
+        assert r.donation["alias_size_bytes"] >= state_bytes, r.program
+    # the cache-hit predict program carries the same passthrough-state
+    # donation contract (maml.PREDICT_DONATE)
+    predict = [
+        r for r in audit_reports if r.program.startswith("predict_step")
+    ]
+    assert len(predict) == 1
+    r = predict[0]
     assert [v for v in r.violations if v.contract == "donation"] == []
-    assert r.donation is not None
-    assert r.donation["donate_argnums"] == list(maml.SERVE_DONATE)
+    assert r.donation["donate_argnums"] == list(maml.PREDICT_DONATE)
     assert r.donation["alias_size_bytes"] >= state_bytes
 
 

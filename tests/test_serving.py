@@ -311,6 +311,16 @@ def test_serving_config_validation():
     # JSON-borne integral floats coerce
     c = make_serving_cfg(serving_bucket_ladder=[1.0, 2.0, 4.0])
     assert c.serving_bucket_ladder == [1, 2, 4]
+    # the PR 13 fast-path knobs validate at config time
+    with pytest.raises(ValueError, match="serving_ingest"):
+        make_serving_cfg(serving_ingest="int4")
+    with pytest.raises(ValueError, match="serving_adapted_cache_size"):
+        make_serving_cfg(serving_adapted_cache_size=-1)
+    with pytest.raises(ValueError, match="cifar"):
+        make_serving_cfg(dataset_name="cifar10", serving_ingest="uint8")
+    c = make_serving_cfg(serving_ingest="uint8",
+                         serving_adapted_cache_size=4.0)
+    assert c.serving_adapted_cache_size == 4  # JSON float coercion
 
 
 # -- batching policy ---------------------------------------------------------
@@ -466,7 +476,11 @@ def test_failed_dispatch_kills_engine_with_root_cause(cfg, state):
     eng.warmup()
     rng = np.random.RandomState(14)
     boom = RuntimeError("device fell over")
-    eng._step = lambda *a, **k: (_ for _ in ()).throw(boom)
+
+    def _explode(*a, **k):
+        raise boom
+
+    eng._programs = {key: _explode for key in eng._programs}
     with pytest.raises(RuntimeError, match="device fell over"):
         eng.serve_group([_request(cfg, rng)])
     with pytest.raises(RuntimeError, match="ServingEngine is dead") as ei:
@@ -502,7 +516,7 @@ def test_serving_telemetry_records_validate(cfg, engine):
     assert records, "engine traffic should have emitted records"
     for rec in records:
         tel.validate_record(rec)
-        assert rec["kind"] == "serving" and rec["schema"] == 8
+        assert rec["kind"] == "serving" and rec["schema"] == 9
     rollup = engine.rollup()
     assert rollup["adapt_ms_p50"] > 0
     assert rollup["adapt_ms_p95"] >= rollup["adapt_ms_p50"]
@@ -623,6 +637,535 @@ def test_engine_serves_restored_snapshot_identically(cfg, state, engine,
         assert a.loss == b.loss
 
 
+# -- batcher shutdown: drain + never-hang (PR 13 satellite) ------------------
+
+
+def test_micro_batcher_close_serves_in_flight_requests(cfg, engine):
+    """Requests still queued at close() (queue neither full nor expired)
+    are SERVED during the drain — responses, not hanging futures."""
+    rng = np.random.RandomState(20)
+    batcher = MicroBatcher(engine, max_tenants=4, max_wait_ms=60_000)
+    pendings = [
+        batcher.submit(_request(cfg, rng, tenant_id=f"d{i}"))
+        for i in range(3)
+    ]
+    batcher.close()  # drain: the group was neither full nor expired
+    for i, p in enumerate(pendings):
+        res = p.get(timeout=1)  # already set; must not block
+        assert res.tenant_id == f"d{i}"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_micro_batcher_worker_crash_fails_futures_not_hangs(cfg, engine):
+    """A worker crash OUTSIDE the dispatch try (the previously uncovered
+    path) must fail every queued future with the root cause — and
+    close() must sweep stragglers — instead of stranding submitters on
+    futures nobody will ever set."""
+    rng = np.random.RandomState(21)
+    batcher = MicroBatcher(engine, max_tenants=2, max_wait_ms=60_000)
+    boom = RuntimeError("scheduler exploded")
+
+    def _explode():
+        raise boom
+
+    with batcher._cond:
+        batcher._ripe_group = _explode  # crash before any dispatch
+    p = batcher.submit(_request(cfg, rng, tenant_id="crash"))
+    with pytest.raises(RuntimeError, match="worker crashed") as ei:
+        p.get(timeout=30)
+    assert ei.value.__cause__ is boom
+    batcher.close()  # must not hang on the dead worker
+    # a request that sneaks into the queues after the worker died is
+    # failed by close()'s sweep, not stranded
+    batcher2 = MicroBatcher(engine, max_tenants=2, max_wait_ms=60_000)
+    batcher2._worker.join(timeout=0)  # worker alive
+    with batcher2._cond:
+        batcher2._ripe_group = _explode
+    p2 = batcher2.submit(_request(cfg, rng))
+    with pytest.raises(RuntimeError):
+        p2.get(timeout=30)
+    batcher2.close()
+
+
+# -- ingest tiers: uint8 + index bit-exactness (PR 13 tentpole) --------------
+
+
+def make_imagenet_serving_cfg(**overrides):
+    """A mini-imagenet-family serving config: 3 channels, /255 decode,
+    ImageNet stat-normalize AND the RGB->BGR flip — the decode rules the
+    uint8/index LUT must reproduce bit-for-bit."""
+    base = dict(
+        dataset_name="mini_imagenet_full_size",
+        image_height=8,
+        image_width=8,
+        image_channels=3,
+        reverse_channels=True,
+        num_classes_per_set=3,
+        num_samples_per_class=1,
+        num_target_samples=2,
+        batch_size=4,
+        cnn_num_filters=4,
+        num_stages=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_remat=False,
+        serving_bucket_ladder=[1, 2],
+        serving_max_tenants_per_dispatch=2,
+        compilation_cache_dir="",
+    )
+    base.update(overrides)
+    return MAMLConfig(**base)
+
+
+def _host_decode(cfg, u8):
+    """The host pipeline's decode of raw uint8 pixels (the reference the
+    on-device LUT must match bit-for-bit)."""
+    from howtotrainyourmamlpytorch_tpu.data.episodes import (
+        augment_stack, decode_cached,
+    )
+
+    flat = np.asarray(u8).reshape((-1,) + u8.shape[-3:])
+    out = augment_stack(cfg, decode_cached(cfg, flat), k=0, augment=False)
+    return np.asarray(out, np.float32).reshape(u8.shape)
+
+
+def _uint8_request(cfg, rng, shots=1, tenant_id=None):
+    n, t = cfg.num_classes_per_set, cfg.num_target_samples
+    h, w, c = cfg.im_shape
+    return AdaptRequest(
+        support_x=rng.randint(0, 256, (n, shots, h, w, c)).astype(np.uint8),
+        support_y=np.tile(np.arange(n, dtype=np.int32)[:, None], (1, shots)),
+        query_x=rng.randint(0, 256, (n, t, h, w, c)).astype(np.uint8),
+        query_y=np.tile(np.arange(n, dtype=np.int32)[:, None], (1, t)),
+        tenant_id=tenant_id,
+    )
+
+
+@pytest.mark.parametrize("cfg_factory", [
+    lambda: make_serving_cfg(serving_bucket_ladder=[1, 2],
+                             serving_max_tenants_per_dispatch=2),
+    make_imagenet_serving_cfg,
+], ids=["omniglot", "mini_imagenet_reverse_channels"])
+def test_uint8_ingest_bit_exact_vs_f32(cfg_factory):
+    """The uint8 serving ingest is bit-exact with the f32 path on both
+    decode families (omniglot unrescaled cast; imagenet /255 +
+    stat-normalize + RGB->BGR) — per-tenant preds, loss, accuracy AND
+    the masked aggregates — while uploading ~4x fewer pixel bytes."""
+    scfg = cfg_factory()
+    state = maml.init_state(scfg)
+    eng_f32 = ServingEngine(scfg, state, shots_buckets=(1,),
+                            strict_retrace=True)
+    eng_u8 = ServingEngine(scfg, state, shots_buckets=(1,),
+                           strict_retrace=True, ingest="uint8")
+    eng_f32.warmup()
+    eng_u8.warmup()
+    rng = np.random.RandomState(22)
+    u8_reqs = [_uint8_request(scfg, rng, tenant_id=f"u{i}")
+               for i in range(2)]
+    f32_reqs = [
+        AdaptRequest(
+            support_x=_host_decode(scfg, r.support_x),
+            support_y=r.support_y,
+            query_x=_host_decode(scfg, r.query_x),
+            query_y=r.query_y,
+            tenant_id=r.tenant_id,
+        )
+        for r in u8_reqs
+    ]
+    dr_u8 = eng_u8.serve_group(u8_reqs)
+    dr_f32 = eng_f32.serve_group(f32_reqs)
+    for a, b in zip(dr_u8.results, dr_f32.results):
+        assert np.array_equal(a.preds, b.preds)
+        assert a.loss == b.loss and a.accuracy == b.accuracy
+    assert dr_u8.metrics == dr_f32.metrics
+    # the ingest tier's point: pixel bytes shrink 4x (labels/mask ride
+    # along at int32, so the total is ≥3x)
+    assert dr_f32.ingest_bytes >= 3 * dr_u8.ingest_bytes
+
+
+def test_index_ingest_bit_exact_vs_f32_and_tiny_h2d(cfg, state):
+    """The index-only ingest: store rows resident in HBM, per-dispatch
+    H2D is the int32 gather + mask (<1KB here) — and the results are
+    bit-exact with the f32 path fed the host-decoded pixels of the same
+    store rows."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1, 2],
+                            serving_max_tenants_per_dispatch=2)
+    st = maml.init_state(scfg)
+    rng = np.random.RandomState(23)
+    n, t = scfg.num_classes_per_set, scfg.num_target_samples
+    h, w, c = scfg.im_shape
+    store = rng.randint(0, 256, (64, h, w, c)).astype(np.uint8)
+    eng_idx = ServingEngine(scfg, st, shots_buckets=(1,),
+                            strict_retrace=True, ingest="index",
+                            store=store)
+    eng_f32 = ServingEngine(scfg, st, shots_buckets=(1,),
+                            strict_retrace=True)
+    eng_idx.warmup()
+    eng_f32.warmup()
+    from howtotrainyourmamlpytorch_tpu.serving import IndexRequest
+
+    reqs, f32_reqs = [], []
+    for i in range(2):
+        si = rng.randint(0, 64, (n, 1)).astype(np.int32)
+        qi = rng.randint(0, 64, (n, t)).astype(np.int32)
+        reqs.append(IndexRequest(support_idx=si, query_idx=qi,
+                                 tenant_id=f"ix{i}"))
+        f32_reqs.append(AdaptRequest(
+            support_x=_host_decode(scfg, store[si]),
+            support_y=np.tile(np.arange(n, dtype=np.int32)[:, None], (1, 1)),
+            query_x=_host_decode(scfg, store[qi]),
+            query_y=np.tile(np.arange(n, dtype=np.int32)[:, None], (1, t)),
+            tenant_id=f"ix{i}",
+        ))
+    dr_idx = eng_idx.serve_group(reqs)
+    dr_f32 = eng_f32.serve_group(f32_reqs)
+    for a, b in zip(dr_idx.results, dr_f32.results):
+        assert np.array_equal(a.preds, b.preds)
+        assert a.loss == b.loss and a.accuracy == b.accuracy
+    assert dr_idx.ingest_bytes < 1024  # index-only dispatch: <1KB H2D
+    assert dr_f32.ingest_bytes > 20 * dr_idx.ingest_bytes
+
+
+def test_index_ingest_validation(cfg, state):
+    scfg = make_serving_cfg(serving_bucket_ladder=[1],
+                            serving_max_tenants_per_dispatch=1)
+    st = maml.init_state(scfg)
+    h, w, c = scfg.im_shape
+    store = np.zeros((8, h, w, c), np.uint8)
+    with pytest.raises(ValueError, match="registered store"):
+        ServingEngine(scfg, st, ingest="index")
+    with pytest.raises(ValueError, match="only applies"):
+        ServingEngine(scfg, st, store=store)
+    eng = ServingEngine(scfg, st, ingest="index", store=store,
+                        strict_retrace=True)
+    from howtotrainyourmamlpytorch_tpu.serving import IndexRequest
+
+    n, t = scfg.num_classes_per_set, scfg.num_target_samples
+    with pytest.raises(ValueError, match="out of range"):
+        eng.serve_group([IndexRequest(
+            support_idx=np.full((n, 1), 8, np.int32),  # == rows: OOB
+            query_idx=np.zeros((n, t), np.int32),
+        )])
+    rng = np.random.RandomState(24)
+    # a uint8 engine refuses float pixels instead of silently casting
+    eng_u8 = ServingEngine(scfg, st, ingest="uint8", strict_retrace=True)
+    with pytest.raises(ValueError, match="uint8"):
+        eng_u8.serve_group([_request(scfg, rng)])
+
+
+# -- adapted-params cache (PR 13 tentpole) -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def cache_engine(cfg, state):
+    """A warmed engine with the adapted-params cache on (shots bucket 1
+    only, to bound the compile bill)."""
+    eng = ServingEngine(
+        cfg, state, shots_buckets=(1,), sink=_ListSink(),
+        strict_retrace=True, cache_size=16,
+    )
+    eng.warmup()
+    return eng
+
+
+def test_cache_hit_bit_exact_same_width_matrix(cfg, state, cache_engine):
+    """The hit/miss/width matrix: for every (group size, bucket) point,
+    a repeat serve of the same tenants is ALL cache hits (predict-only
+    program) and bit-exact with the original full adaptation — preds,
+    loss, accuracy per tenant. Width is matched pairwise (repeat group
+    == original group), the same width discipline every other
+    bit-exactness contract in this file pins."""
+    rng = np.random.RandomState(25)
+    for n_real, bucket in ((1, 1), (2, 2), (3, 4), (4, 4)):
+        reqs = [_request(cfg, rng, tenant_id=f"m{n_real}-{i}")
+                for i in range(n_real)]
+        dr_first = cache_engine.serve_group(reqs)
+        assert dr_first.cache_hits == 0 and dr_first.bucket == bucket
+        dr_repeat = cache_engine.serve_group(reqs)
+        assert dr_repeat.cache_hits == n_real  # all hits: no inner loop
+        for a, b in zip(dr_first.results, dr_repeat.results):
+            assert np.array_equal(a.preds, b.preds)
+            assert a.loss == b.loss and a.accuracy == b.accuracy
+        assert dr_repeat.metrics == dr_first.metrics
+
+
+def test_mixed_hit_miss_group_splits_cleanly(cfg, state, cache_engine):
+    """A half-hit/half-miss group splits into one adapt + one predict
+    dispatch; every tenant's result is bit-exact with its matched-width
+    reference (hits vs their first adaptation, misses vs a fresh
+    same-width adapt), and the telemetry records both program families."""
+    rng = np.random.RandomState(26)
+    known = [_request(cfg, rng, tenant_id=f"k{i}") for i in range(2)]
+    dr_known = cache_engine.serve_group(known)  # adapt at bucket 2
+    fresh = [_request(cfg, rng, tenant_id=f"f{i}") for i in range(2)]
+    n_before = len(cache_engine.sink.records)
+    dr_mixed = cache_engine.serve_group([known[0], fresh[0], known[1],
+                                         fresh[1]])
+    assert dr_mixed.cache_hits == 2 and dr_mixed.tenants == 4
+    # hits (bucket 2 predict) reproduce their first adaptation (bucket 2
+    # adapt) bit-for-bit
+    assert np.array_equal(dr_mixed.results[0].preds,
+                          dr_known.results[0].preds)
+    assert np.array_equal(dr_mixed.results[2].preds,
+                          dr_known.results[1].preds)
+    assert dr_mixed.results[0].loss == dr_known.results[0].loss
+    # misses (bucket 2 adapt) match a fresh cacheless engine at the same
+    # width
+    eng_plain = ServingEngine(cfg, state, shots_buckets=(1,),
+                              strict_retrace=True)
+    dr_fresh = eng_plain.serve_group(fresh)
+    assert np.array_equal(dr_mixed.results[1].preds,
+                          dr_fresh.results[0].preds)
+    assert np.array_equal(dr_mixed.results[3].preds,
+                          dr_fresh.results[1].preds)
+    # both program families appear in the telemetry for the mixed group
+    progs = [
+        r.get("program") for r in cache_engine.sink.records[n_before:]
+        if r.get("kind") == "serving" and r.get("event") == "dispatch"
+    ]
+    assert sorted(progs) == ["adapt", "predict"]
+
+
+def test_cache_lru_evicts_and_readapts(cfg, state):
+    """Eviction: a tenant pushed out of a capacity-2 LRU re-adapts on
+    its next visit (miss), and its re-adapted results equal the
+    originals at the same width (determinism of adaptation)."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1, 2],
+                            serving_max_tenants_per_dispatch=2)
+    st = maml.init_state(scfg)
+    eng = ServingEngine(scfg, st, shots_buckets=(1,),
+                        strict_retrace=True, cache_size=2)
+    eng.warmup()
+    rng = np.random.RandomState(27)
+    a, b, c = (_request(scfg, rng, tenant_id=t) for t in "abc")
+    dr_a1 = eng.serve_group([a])
+    eng.serve_group([b])
+    eng.serve_group([c])  # evicts a (LRU capacity 2)
+    assert len(eng._cache) == 2
+    dr_a2 = eng.serve_group([a])
+    assert dr_a2.cache_hits == 0  # evicted: full re-adaptation
+    assert np.array_equal(dr_a1.results[0].preds, dr_a2.results[0].preds)
+    dr_a3 = eng.serve_group([a])
+    assert dr_a3.cache_hits == 1  # back in the cache
+    assert np.array_equal(dr_a1.results[0].preds, dr_a3.results[0].preds)
+
+
+def test_mixed_group_hits_survive_miss_eviction(cfg, state):
+    """Regression: in a mixed group, inserting the MISSES' fast weights
+    can evict the HITS' LRU entries before the predict dispatch reads
+    them — the hit weights must be snapshotted at lookup time, so the
+    group still serves (and stays bit-exact), never KeyErrors."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1, 2, 4],
+                            serving_max_tenants_per_dispatch=4)
+    st = maml.init_state(scfg)
+    eng = ServingEngine(scfg, st, shots_buckets=(1,),
+                        strict_retrace=True, cache_size=2)
+    eng.warmup()
+    rng = np.random.RandomState(31)
+    a, b, c, d = (_request(scfg, rng, tenant_id=t) for t in "abcd")
+    dr_a = eng.serve_group([a, b])  # a, b cached (capacity 2: full)
+    # hits {a, b} + misses {c, d}: the miss inserts evict a and b from
+    # the capacity-2 LRU while the group is still in flight
+    dr_mix = eng.serve_group([a, b, c, d])
+    assert dr_mix.cache_hits == 2
+    assert np.array_equal(dr_mix.results[0].preds, dr_a.results[0].preds)
+    assert np.array_equal(dr_mix.results[1].preds, dr_a.results[1].preds)
+    assert len(eng._cache) == 2  # c, d now occupy the LRU
+
+
+def test_cache_key_scopes_snapshot_and_content(cfg, state):
+    """The cache key covers support content AND the snapshot id: a
+    different support set or a different checkpoint can never hit a
+    stale entry."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1],
+                            serving_max_tenants_per_dispatch=1)
+    st = maml.init_state(scfg)
+    eng = ServingEngine(scfg, st, shots_buckets=(1,),
+                        strict_retrace=True, cache_size=8,
+                        snapshot_id="ckpt-1")
+    eng.warmup()
+    rng = np.random.RandomState(28)
+    req = _request(scfg, rng, tenant_id="t")
+    eng.serve_group([req])
+    # same support, different queries: still a hit (the key is the
+    # SUPPORT fingerprint — queries ride the predict program)
+    req2 = AdaptRequest(
+        support_x=req.support_x.copy(), support_y=req.support_y.copy(),
+        query_x=rng.randn(*req.query_x.shape).astype(np.float32),
+        query_y=req.query_y.copy(), tenant_id="t",
+    )
+    assert eng.serve_group([req2]).cache_hits == 1
+    # perturbed support content: miss
+    req3 = AdaptRequest(
+        support_x=req.support_x + 1.0, support_y=req.support_y.copy(),
+        query_x=req.query_x.copy(), query_y=req.query_y.copy(),
+    )
+    assert eng.serve_group([req3]).cache_hits == 0
+    # same request against another snapshot id: a different engine's
+    # cache can never confuse the two (keys differ by construction)
+    eng2 = ServingEngine(scfg, st, shots_buckets=(1,),
+                         strict_retrace=True, cache_size=8,
+                         snapshot_id="ckpt-2")
+    assert eng._cache_key(req, 1) != eng2._cache_key(req, 1)
+
+
+def test_predict_program_has_no_inner_loop_ops(cfg):
+    """The op-census proof that cache hits skip the inner loop: the
+    predict-only program carries at most ONE forward's worth of
+    matmul/conv ops — several times fewer than the adapt program, whose
+    every inner step pays a support forward + backward + target forward.
+    (The same censuses are pinned in CONTRACTS.json via `cli audit`.)"""
+    from howtotrainyourmamlpytorch_tpu.analysis.auditor import (
+        audit_system_programs,
+    )
+
+    b = cfg.batch_size
+    reports = {
+        r.program: r for r in audit_system_programs(
+            cfg, programs=[f"serve_step[b={b}]", f"predict_step[b={b}]"]
+        )
+    }
+    def matmul_ops(census):
+        return census.get("dot", 0) + census.get("convolution", 0)
+
+    serve_ops = matmul_ops(reports[f"serve_step[b={b}]"].census)
+    predict_ops = matmul_ops(reports[f"predict_step[b={b}]"].census)
+    assert predict_ops > 0
+    # 2 eval inner steps x (support fwd + bwd(~2x fwd) + target fwd)
+    # ≈ 8 forward-equivalents vs predict's single forward
+    assert predict_ops * 4 <= serve_ops
+    # and the predict program still honors the donation contract
+    assert reports[f"predict_step[b={b}]"].ok
+
+
+# -- zero-retrace across all three ingest modes ------------------------------
+
+
+def test_steady_state_all_ingest_modes_never_retrace(cfg, state):
+    """Sustained mixed traffic across the three ingest tiers AND the
+    hit/miss split (every group size, repeat tenants interleaved with
+    fresh ones) stays on the warmed program set: zero retraces under the
+    strict detector on every engine."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1, 2],
+                            serving_max_tenants_per_dispatch=2)
+    st = maml.init_state(scfg)
+    h, w, c = scfg.im_shape
+    n, t = scfg.num_classes_per_set, scfg.num_target_samples
+    rng = np.random.RandomState(29)
+    store = rng.randint(0, 256, (32, h, w, c)).astype(np.uint8)
+    engines = {
+        "f32": ServingEngine(scfg, st, shots_buckets=(1,),
+                             strict_retrace=True, cache_size=8),
+        "uint8": ServingEngine(scfg, st, shots_buckets=(1,),
+                               strict_retrace=True, ingest="uint8"),
+        "index": ServingEngine(scfg, st, shots_buckets=(1,),
+                               strict_retrace=True, ingest="index",
+                               store=store),
+    }
+    for eng in engines.values():
+        eng.warmup()
+    from howtotrainyourmamlpytorch_tpu.serving import IndexRequest
+
+    f32_pool = [_request(scfg, rng, tenant_id=f"p{i}") for i in range(3)]
+    for round_i in range(3):
+        for size in (1, 2):
+            engines["f32"].serve_group(
+                [f32_pool[(round_i + j) % 3] for j in range(size)][:size]
+                if round_i else
+                [_request(scfg, rng) for _ in range(size)]
+            )
+            engines["uint8"].serve_group(
+                [_uint8_request(scfg, rng) for _ in range(size)]
+            )
+            engines["index"].serve_group([
+                IndexRequest(
+                    support_idx=rng.randint(0, 32, (n, 1)).astype(np.int32),
+                    query_idx=rng.randint(0, 32, (n, t)).astype(np.int32),
+                )
+                for _ in range(size)
+            ])
+    for name, eng in engines.items():
+        assert eng.retrace_detector.retrace_count == 0, name
+
+
+# -- AOT export artifacts (PR 13 tentpole) -----------------------------------
+
+
+def test_export_artifacts_zero_compile_warmup_bit_exact(cfg, state,
+                                                        tmp_path):
+    """The export round trip: a first warmup compiles-then-saves, a
+    FRESH engine's warmup deserializes the artifacts with ZERO XLA
+    compilations (the compile-count assertion) and measurably faster,
+    serves bit-identically to the compiled engine, and still passes the
+    strict zero-retrace gate."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1],
+                            serving_max_tenants_per_dispatch=1)
+    st = maml.init_state(scfg)
+    root = str(tmp_path / "artifacts")
+    eng1 = ServingEngine(scfg, st, shots_buckets=(1,),
+                         strict_retrace=True)
+    s1 = eng1.warmup(artifact_dir=root)
+    assert eng1.warmup_stats["mode"] == "compile"
+    assert eng1.warmup_stats["xla_compiles"] >= 1
+    eng2 = ServingEngine(scfg, st, shots_buckets=(1,),
+                         strict_retrace=True, sink=_ListSink())
+    s2 = eng2.warmup(artifact_dir=root)
+    assert eng2.warmup_stats["mode"] == "artifacts"
+    assert eng2.warmup_stats["xla_compiles"] == 0  # the whole point
+    assert s2 < s1  # deserialize beats compile
+    rng = np.random.RandomState(30)
+    req = _request(scfg, rng, tenant_id="x")
+    dr1, dr2 = eng1.serve_group([req]), eng2.serve_group([req])
+    assert np.array_equal(dr1.results[0].preds, dr2.results[0].preds)
+    assert dr1.results[0].loss == dr2.results[0].loss
+    assert eng2.retrace_detector.retrace_count == 0
+    # the warmup telemetry record documents the artifact path
+    warm = [r for r in eng2.sink.records if r.get("event") == "warmup"]
+    assert len(warm) == 1 and warm[0]["mode"] == "artifacts"
+    assert warm[0]["xla_compiles"] == 0
+    tel.validate_record(warm[0])
+
+
+def test_export_artifacts_mismatch_falls_back_to_compile(cfg, state,
+                                                         tmp_path):
+    """A stale/foreign artifact dir (different config fingerprint) must
+    degrade to the compile path — never load a wrong program."""
+    scfg = make_serving_cfg(serving_bucket_ladder=[1],
+                            serving_max_tenants_per_dispatch=1)
+    st = maml.init_state(scfg)
+    root = str(tmp_path / "artifacts")
+    eng1 = ServingEngine(scfg, st, shots_buckets=(1,), strict_retrace=True)
+    eng1.warmup(artifact_dir=root)
+    # a config with a different geometry fingerprints differently and
+    # must not see eng1's artifacts
+    other = make_serving_cfg(serving_bucket_ladder=[1],
+                             serving_max_tenants_per_dispatch=1,
+                             num_target_samples=3)
+    eng2 = ServingEngine(other, maml.init_state(other), shots_buckets=(1,),
+                         strict_retrace=True)
+    eng2.warmup(artifact_dir=root)
+    assert eng2.warmup_stats["mode"] == "compile"
+    # and the fallback SAVED its own artifacts: a third engine loads
+    eng3 = ServingEngine(other, maml.init_state(other), shots_buckets=(1,),
+                         strict_retrace=True)
+    eng3.warmup(artifact_dir=root)
+    assert eng3.warmup_stats["mode"] == "artifacts"
+
+
+def test_rollup_carries_fast_path_fields(cfg, cache_engine):
+    """The v9 rollup surface: ingest, h2d_bytes_per_dispatch and
+    cache_hit_rate ride the rollup (and validate against the schema)."""
+    rollup = cache_engine.rollup()
+    assert rollup["ingest"] == "f32"
+    assert rollup["h2d_bytes_per_dispatch"] > 0
+    assert 0.0 <= rollup["cache_hit_rate"] <= 1.0
+    rec = cache_engine.sink.records[-1]
+    assert rec["event"] == "rollup"
+    tel.validate_record(rec)
+
+
 # -- serve-bench (compile-heavy e2e: slow lane) ------------------------------
 
 
@@ -647,6 +1190,7 @@ def test_serve_bench_fast_end_to_end(tmp_path, capsys):
     assert rec["tenants_per_sec"] > 0
     assert rec["tenants"] == 7
     assert rec["retraces"] == 0
-    assert tel.validate_file(str(log)) == rec["dispatches"] + 1
+    # per-dispatch records + the warmup record + the rollup
+    assert tel.validate_file(str(log)) == rec["dispatches"] + 2
     assert telemetry_cli.main(["summary", str(log)]) == 0
     assert "serving:" in capsys.readouterr().out

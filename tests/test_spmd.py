@@ -60,6 +60,8 @@ def test_spmd_family_has_expected_programs(spmd_audit_reports):
         "eval_multi_step[k=2]",
         "index_expander",
         "serve_step[b=8]",
+        "serve_step_uint8[b=8]",
+        "predict_step[b=8]",
     }
     assert all(r.mesh_spec == "2x4" for r in spmd_audit_reports)
 
@@ -549,7 +551,7 @@ def test_cli_audit_mesh_end_to_end(tmp_path, spmd_micro_cfg, capsys):
 
 def test_pinned_repo_baseline_has_mesh_entries():
     """CONTRACTS.json at the repo root carries the 1x8 mesh-keyed SPMD
-    entries next to the seven single-device ones (the `cli audit --mesh
+    entries next to the nine single-device ones (the `cli audit --mesh
     1x8` CI gate compares against them)."""
     baseline = contracts_lib.load_baseline()
     assert baseline is not None, "CONTRACTS.json missing at the repo root"
@@ -557,8 +559,8 @@ def test_pinned_repo_baseline_has_mesh_entries():
     plain_keys = [k for k in baseline["programs"] if "@" not in k.replace(
         "@cpu", "", 1
     )]
-    assert len(mesh_keys) == 7
-    assert len(plain_keys) == 7
+    assert len(mesh_keys) == 9
+    assert len(plain_keys) == 9
     train_key = contracts_lib.spmd_census_key(
         "train_step[so=1]", "cpu", "1x8"
     )

@@ -740,6 +740,37 @@ def test_v8_serving_record_kind_validates():
         })
 
 
+def test_validate_file_accepts_v8_era_fixture():
+    """The pinned v8-era log (written before the v9 serving fast-path
+    fields existed) validates unchanged under the v9 validator — the
+    backward half of the version contract: v9 is purely additive."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v8_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 6
+
+
+def test_v9_serving_fast_path_fields_validate():
+    """The schema v9 additions: serving dispatch records with the ingest
+    / cache fields, the event='warmup' shape, and the extended rollup
+    all round-trip through make_record and validate."""
+    tel.validate_record(tel.make_record(
+        "serving", event="dispatch", tenants=3, bucket=4, shots=1,
+        queue_ms=0.8, adapt_ms=4.2, program="predict", ingest="uint8",
+        ingest_bytes=1536, cache_hits=3,
+    ))
+    tel.validate_record(tel.make_record(
+        "serving", event="warmup", mode="artifacts", warmup_ms=312.0,
+        xla_compiles=0, programs=8, ingest="f32",
+    ))
+    tel.validate_record(tel.make_record(
+        "serving", event="rollup", dispatches=12, tenants=31,
+        adapt_ms_p50=4.1, adapt_ms_p95=9.9, tenants_per_sec=120.5,
+        retraces=0, ingest="index", h2d_bytes_per_dispatch=412.0,
+        cache_hit_rate=0.62,
+    ))
+
+
 # -- non-finite masking is counted, not silent (sinks.make_record) ----------
 
 
