@@ -489,6 +489,109 @@ def _measure_health_overhead(
     )
 
 
+def _measure_tracing_overhead(
+    cfg, mesh, batch, weights, reduced: bool
+) -> dict | None:
+    """Host-side span-emission cost (``tracing_level='on'`` vs off).
+
+    Tracing never touches the jitted program (the span layer is pure
+    host bookkeeping around the dispatch), so unlike the telemetry/
+    health overheads there is no second executable to build: BOTH arms
+    time the SAME compiled step back to back — off (bare loop), then on
+    (each dispatch wrapped in a ``train_dispatch`` span emitted to a
+    real JSONL sink, exactly what the builder does) — which cancels the
+    systematic warmup drift a cross-harness comparison would carry.
+    The off arm is taken as the min of two passes (the steadier
+    estimator for a noise floor). Asserted <5% in test_bench;
+    BENCH_SKIP_TRACING_OVERHEAD=1 skips. Informational — never part of
+    baseline comparability.
+    """
+    import tempfile
+
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import (
+        JsonlSink,
+        make_record,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry.tracing import Tracer
+
+    steps_n = int(
+        os.environ.get("BENCH_TRACING_STEPS", "4" if reduced else "10")
+    )
+    tmp = None
+    try:
+        state = maml.init_state(cfg)
+        if mesh is not None:
+            from howtotrainyourmamlpytorch_tpu.parallel import (
+                mesh as mesh_lib,
+            )
+
+            state = mesh_lib.replicate_state(mesh, state)
+        step = jax.jit(
+            maml.make_train_step(cfg, second_order=True),
+            donate_argnums=maml.TRAIN_DONATE,
+        )
+        x_s, y_s, x_t, y_t = batch
+
+        def run(n, tracer):
+            nonlocal state
+            m = None
+            start = time.perf_counter()
+            for _ in range(n):
+                with tracer.span("train_dispatch", cat="train"):
+                    state, m = step(
+                        state, x_s, y_s, x_t, y_t, weights, 1e-3
+                    )
+            jax.block_until_ready(state.net)
+            float(np.asarray(m["loss"]))  # tunnel-proof sync (see sync())
+            return (time.perf_counter() - start) / n * 1e3
+
+        from howtotrainyourmamlpytorch_tpu.telemetry.tracing import (
+            NULL_TRACER,
+        )
+
+        run(1, NULL_TRACER)  # compile + warm
+        tmp = tempfile.NamedTemporaryFile(
+            suffix=".jsonl", delete=False
+        )
+        tmp.close()
+        sink = JsonlSink(tmp.name)
+        tracer = Tracer(
+            emit=lambda **f: sink.write(make_record("span", **f))
+        )
+        # interleave two passes per arm and take each arm's min: the
+        # steadier noise-floor estimator, so the quoted overhead_pct is
+        # the tracing layer's cost, not scheduler jitter
+        off_a = run(steps_n, NULL_TRACER)
+        on_a = run(steps_n, tracer)
+        off_b = run(steps_n, NULL_TRACER)
+        on_b = run(steps_n, tracer)
+        sink.close()
+        off_ms = min(off_a, off_b)
+        on_ms = min(on_a, on_b)
+        return {
+            "off_ms_per_step": round(off_ms, 3),
+            "spans_ms_per_step": round(on_ms, 3),
+            "overhead_pct": (
+                round((on_ms - off_ms) / off_ms * 100, 2)
+                if off_ms > 0 else None
+            ),
+            "timed_steps": steps_n,
+        }
+    except Exception as e:  # noqa: BLE001 - informational metric only
+        print(f"bench: tracing_overhead measurement failed ({e!r})",
+              file=sys.stderr)
+        return None
+    finally:
+        if tmp is not None:
+            try:
+                os.remove(tmp.name)
+            except OSError:
+                pass
+
+
 def _measure_serving(cfg, reduced: bool) -> dict | None:
     """Adapt-on-request serving latency/throughput on the flagship task
     geometry (ROADMAP item 1): a ``ServingEngine`` over a fresh snapshot
@@ -808,6 +911,14 @@ def main() -> None:
             elapsed / timed_steps * 1e3, reduced,
         )
 
+    # host-side span-emission cost (tracing_level='on' vs off): null when
+    # skipped or unmeasurable
+    tracing_overhead = None
+    if os.environ.get("BENCH_SKIP_TRACING_OVERHEAD") != "1":
+        tracing_overhead = _measure_tracing_overhead(
+            cfg, mesh, (x_s, y_s, x_t, y_t), weights, reduced,
+        )
+
     # adapt-on-request serving latency p50/p95 + tenants/sec (serving/):
     # null when skipped or unmeasurable
     serving = None
@@ -913,6 +1024,10 @@ def main() -> None:
         # step time with health_level='monitor' vs off (informational —
         # not part of baseline comparability)
         "health_overhead": health_overhead,
+        # step time with spans emitted around each dispatch vs off
+        # (informational — not part of baseline comparability; asserted
+        # <5% in test_bench)
+        "tracing_overhead": tracing_overhead,
         # adapt-on-request serving: adaptation_latency_ms p50/p95 and
         # tenants_per_sec under the strict zero-retrace gate
         # (informational — not part of baseline comparability)
@@ -973,7 +1088,8 @@ def main() -> None:
             if k not in ("vs_baseline", "baseline_backend",
                          "baseline_refreshed", "epoch_boundary",
                          "input_pipeline", "telemetry_overhead",
-                         "health_overhead", "serving", "hlo_cost",
+                         "health_overhead", "tracing_overhead",
+                         "serving", "hlo_cost",
                          "donation", "roofline")
         }
         with open(baseline_path, "w") as f:

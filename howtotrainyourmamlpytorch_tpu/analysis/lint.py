@@ -32,7 +32,14 @@ traced, which must donate, which I/O must retry:
   owndata=False corruption class: numpy views over tensorstore-owned
   capsules that die with the restore context). The owning spelling is
   ``np.array(...)`` (or ``.copy()``); a justified view carries a reasoned
-  ``# lint-ok: MP006`` suppression.
+  ``# lint-ok: MP006`` suppression;
+* **MP007** — ``time.time()`` anywhere: the wall clock steps under NTP
+  slew/DST and must never measure a DURATION — durations are
+  ``time.perf_counter()`` (what every span, latency decomposition and
+  step timer uses; a clock mix also breaks cross-record correlation in
+  the trace timeline). The handful of genuine wall-clock TIMESTAMPS
+  (record ``ts`` envelopes, mtime comparisons) carry a reasoned
+  ``# lint-ok: MP007`` suppression.
 
 Run via ``python -m howtotrainyourmamlpytorch_tpu.cli lint [paths...]``
 (defaults to the package + ``bench.py``); exits nonzero on violations.
@@ -59,6 +66,9 @@ RULES: Dict[str, str] = {
     "MP006": "non-owning numpy view over restored/foreign memory "
              "(np.frombuffer, or np.asarray in the checkpoint restore "
              "seam) — use an owning np.array copy",
+    "MP007": "time.time() used where a duration may be measured — use "
+             "time.perf_counter(); genuine wall-clock timestamps carry "
+             "a reasoned suppression",
 }
 
 #: builtins whose call inside a traced scope forces a host sync or bakes a
@@ -345,6 +355,63 @@ def _check_view_over_foreign_memory(
     return out
 
 
+def _time_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Names that resolve to ``time.time`` in this module: the ``time``
+    module's aliases -> 'module', plus direct ``from time import time``
+    bindings -> 'func'."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    out[a.asname or "time"] = "module"
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    out[a.asname or "time"] = "func"
+    return out
+
+
+def _check_wall_clock(path: str, tree: ast.Module) -> List[Violation]:
+    """MP007 — every ``time.time()`` call (however ``time`` is bound).
+
+    A duration-vs-timestamp dataflow analysis would miss aliased reads,
+    so the rule is total: perf_counter is ALWAYS correct for durations,
+    and the few legitimate wall-clock timestamps (record ``ts`` fields,
+    mtime comparisons) each carry a reasoned suppression — which also
+    documents, in place, why the wall clock is the right clock there.
+    """
+    aliases = _time_aliases(tree)
+    if not aliases:
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = False
+        if isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            root = chain.split(".")[0]
+            if (
+                chain.endswith(".time")
+                and chain.count(".") == 1
+                and aliases.get(root) == "module"
+            ):
+                hit = True
+        elif isinstance(func, ast.Name):
+            if aliases.get(func.id) == "func":
+                hit = True
+        if hit:
+            out.append(Violation(
+                path, node.lineno, "MP007",
+                "time.time() steps with the wall clock; measure "
+                "durations with time.perf_counter() (a genuine "
+                "timestamp needs `# lint-ok: MP007 <why wall clock>`)",
+            ))
+    return out
+
+
 def _apply_suppressions(
     violations: List[Violation], path: str, source_lines: List[str]
 ) -> List[Violation]:
@@ -408,6 +475,7 @@ def lint_file(path: str) -> List[Violation]:
     violations += _check_view_over_foreign_memory(
         path, tree, restore_seam=(rel == "experiment/checkpoint.py")
     )
+    violations += _check_wall_clock(path, tree)
     return _apply_suppressions(violations, path, source.splitlines())
 
 

@@ -18,6 +18,16 @@ The ``inspect`` subcommand is the telemetry reader
 It is dispatched before any jax-importing module loads, so inspection
 works on a machine with nothing but the repo and numpy installed.
 
+The ``trace`` subcommand (tools/trace_cli.py — pure stdlib, also
+dispatched jax-free) renders a run's schema-v10 ``span`` records as a
+loadable Chrome/Perfetto trace-event JSON plus a critical-path summary
+(the serving queue/assemble/dispatch/sync latency decomposition per
+(program, bucket, shots), the train/data span profile, and any
+on-demand device-profile windows):
+
+    python -m howtotrainyourmamlpytorch_tpu.cli trace LOG
+    python -m howtotrainyourmamlpytorch_tpu.cli trace LOG --out run.trace.json
+
 The ``lint`` subcommand (analysis/lint.py — pure stdlib, also dispatched
 jax-free) runs the repo-specific JAX-pitfall linter; the ``audit``
 subcommand (tools/audit_cli.py — needs jax) statically verifies the
@@ -135,6 +145,12 @@ def main(argv=None):
         from .tools.telemetry_cli import main as telemetry_main
 
         raise SystemExit(telemetry_main(args[1:]))
+    if args and args[0] == "trace":
+        # span-timeline renderer (Chrome/Perfetto trace + critical-path
+        # summary): pure stdlib, dispatched jax-free like inspect
+        from .tools.trace_cli import main as trace_main
+
+        raise SystemExit(trace_main(args[1:]))
     if args and args[0] == "lint":
         # repo-specific JAX-pitfall linter: pure stdlib, jax-free
         from .analysis.lint import main as lint_main

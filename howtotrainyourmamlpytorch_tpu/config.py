@@ -251,6 +251,17 @@ class MAMLConfig:
     #              syncs; flushed to the JSONL log at epoch-summary time.
     telemetry_level: str = "off"
     telemetry_tensorboard: bool = False  # mirror epoch scalars to TensorBoard
+    # causal tracing (telemetry/tracing.py): 'on' emits schema-v10 `span`
+    # records — train dispatch / eval chunk / epoch summary / checkpoint
+    # intervals, data-producer sample/stack/queue-put intervals, and (in a
+    # serving process) the per-request queue/assemble/dispatch/sync
+    # decomposition — into the telemetry JSONL for `cli trace` to render
+    # as a Chrome/Perfetto timeline. Requires telemetry_level != 'off'
+    # (spans ride the same sink). 'off' (default) allocates no span
+    # objects and leaves every jitted program bit-identical (the
+    # telemetry_level='off' proof standard); tracing is host-side only
+    # and never adds a device sync either way.
+    tracing_level: str = "off"  # 'off' | 'on'
     # heartbeat hang watchdog: when > 0, a daemon thread dumps a diagnostic
     # JSONL record + all-thread stack snapshot if the train/eval/checkpoint
     # loop reports no progress for this many seconds (multihost hang
@@ -590,6 +601,17 @@ class MAMLConfig:
             raise ValueError(
                 f"telemetry_level must be 'off', 'scalars' or 'dynamics', "
                 f"got {self.telemetry_level!r}"
+            )
+        if self.tracing_level not in ("off", "on"):
+            raise ValueError(
+                f"tracing_level must be 'off' or 'on', got "
+                f"{self.tracing_level!r}"
+            )
+        if self.tracing_level == "on" and self.telemetry_level == "off":
+            raise ValueError(
+                "tracing_level='on' requires telemetry_level != 'off': "
+                "span records ride the telemetry JSONL sink (enable "
+                "telemetry_level='scalars' or 'dynamics')"
             )
         # serving knobs: the ladder must be strictly increasing positive
         # ints (JSON configs may carry integral floats — coerce), and

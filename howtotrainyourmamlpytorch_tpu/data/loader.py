@@ -31,6 +31,7 @@ import numpy as np
 
 from ..config import MAMLConfig
 from ..resilience import elastic, faults
+from ..telemetry import tracing
 from . import datasets as ds
 from .episodes import Episode, IndexEpisode, sample_episode, sample_episode_indices
 
@@ -238,6 +239,14 @@ class MetaLearningDataLoader:
             "assembly_s": 0.0, "stall_s": 0.0, "depth_sum": 0.0, "batches": 0,
         }
         self._last_producer_thread: Optional[threading.Thread] = None
+        # causal tracing (telemetry/tracing.py): the builder swaps in its
+        # run tracer when tracing_level='on'; the default disabled tracer
+        # keeps every producer/consumer seam at one attribute check.
+        # Producer-thread spans (sample / stack / queue_put) correlate
+        # with the consumer_wait spans the pull side emits, so a starved
+        # device shows up as consumer_wait intervals opposite a
+        # stall-free producer timeline (and vice versa)
+        self.tracer = tracing.NULL_TRACER
         # a producer thread's death is latched here and re-raised from
         # every subsequent batch pull (not only the generator that owned
         # the thread): a dead producer means the episode stream is broken
@@ -340,6 +349,7 @@ class MetaLearningDataLoader:
 
         def producer():
             try:
+                tracer = self.tracer
                 with concurrent.futures.ThreadPoolExecutor(workers) as pool:
                     for b in range(total_batches):
                         if stop.is_set():
@@ -351,10 +361,29 @@ class MetaLearningDataLoader:
                         # this host's slice of the global batch's task range
                         idxs = range(b * tpb + lo, b * tpb + hi)
                         t0 = time.perf_counter()
-                        batch = stack(list(pool.map(build, idxs)))
+                        # producer spans (tracing on): sample = episode
+                        # building across the worker pool, stack = the
+                        # numpy batch assembly, queue_put = blocked-on-
+                        # full-queue time — the producer-side timeline
+                        # the consumer_wait spans correlate against
+                        sample_span = tracer.start_span(
+                            "sample", cat="data", set=set_name, batch=b,
+                        )
+                        episodes = list(pool.map(build, idxs))
+                        tracer.end_span(sample_span)
+                        stack_span = tracer.start_span(
+                            "stack", cat="data", set=set_name, batch=b,
+                        )
+                        batch = stack(episodes)
+                        tracer.end_span(stack_span)
                         t1 = time.perf_counter()
+                        put_span = tracer.start_span(
+                            "queue_put", cat="data", set=set_name, batch=b,
+                        )
                         if not put(batch):
+                            tracer.end_span(put_span, outcome="abandoned")
                             return
+                        tracer.end_span(put_span)
                         t2 = time.perf_counter()
                         with self._stats_lock:
                             self.stream_stats["assembly_s"] += t1 - t0
@@ -376,22 +405,40 @@ class MetaLearningDataLoader:
         thread.start()
         try:
             while True:
+                wait_span = None
                 try:
-                    # timed poll, NOT a bare blocking get: a producer that
-                    # died between enqueues (or whose error enqueue lost the
-                    # race) would otherwise park the consumer forever
-                    item = out.get(timeout=0.2)
-                except queue.Empty:
-                    if self._producer_error is not None:
-                        self._raise_producer_error()
-                    if not thread.is_alive():
-                        # died without latching anything (e.g. killed
-                        # interpreter-side): still never block forever
-                        raise ProducerCrashedError(
-                            f"episode producer thread for set {set_name!r} "
-                            "died without delivering a batch or an error"
-                        )
-                    continue
+                    while True:
+                        try:
+                            # timed poll, NOT a bare blocking get: a
+                            # producer that died between enqueues (or whose
+                            # error enqueue lost the race) would otherwise
+                            # park the consumer forever
+                            item = out.get(timeout=0.2)
+                            break
+                        except queue.Empty:
+                            if wait_span is None:
+                                # a consumer stall span: opened only once
+                                # the first poll came up empty, so a hot
+                                # queue emits nothing (and the off path is
+                                # one attribute check inside start_span)
+                                wait_span = self.tracer.start_span(
+                                    "consumer_wait", cat="data",
+                                    set=set_name,
+                                )
+                            if self._producer_error is not None:
+                                self._raise_producer_error()
+                            if not thread.is_alive():
+                                # died without latching anything (e.g.
+                                # killed interpreter-side): still never
+                                # block forever
+                                raise ProducerCrashedError(
+                                    f"episode producer thread for set "
+                                    f"{set_name!r} died without delivering "
+                                    "a batch or an error"
+                                )
+                            continue
+                finally:
+                    self.tracer.end_span(wait_span)
                 if item is None:
                     return
                 if isinstance(item, BaseException):

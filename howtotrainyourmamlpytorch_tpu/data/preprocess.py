@@ -147,7 +147,7 @@ def build_set_cache_flat(
         # _STALE_TEMP_AGE_S regardless of pid — covers remote builders on
         # shared storage and pid-reuse leaks; a live builder's memmap writes
         # keep refreshing its temp's mtime long before that threshold.
-        now = time.time()
+        now = time.time()  # lint-ok: MP007 compared against file st_mtime, which is wall clock
         for path_base in (data_path, meta_path):
             for stale in glob.glob(f"{path_base}.tmp.*"):
                 try:

@@ -43,7 +43,13 @@ from ..resilience import (
     faults,
 )
 from ..telemetry import FlightRecorder, HealthMonitor, Telemetry, Watchdog
-from ..utils.profiling import StepTimer, TraceWindow
+from ..telemetry import tracing
+from ..utils.profiling import (
+    PROFILE_REQUEST_FILENAME,
+    OnDemandProfiler,
+    StepTimer,
+    TraceWindow,
+)
 from ..utils.storage import (
     build_experiment_folder,
     save_statistics,
@@ -202,7 +208,9 @@ class ExperimentBuilder:
         )
         # train-time augmentation only for omniglot (experiment_builder.py:60)
         self.augment_flag = "omniglot" in cfg.dataset_name.lower()
-        self.start_time = time.time()
+        # perf_counter, not time.time(): epoch_run_time is a DURATION and
+        # must survive wall-clock steps (NTP slew, DST) — lint rule MP007
+        self.start_time = time.perf_counter()
         self.epochs_done_in_this_run = 0
         # per-step timing as first-class metrics (SURVEY.md §5 — the
         # reference only records epoch_run_time)
@@ -264,6 +272,21 @@ class ExperimentBuilder:
             # two runs' logs explain their own divergence
             config=dataclasses.asdict(cfg),
         )
+        # causal tracing (telemetry/tracing.py, schema v10): span records
+        # for train dispatch / eval chunk / epoch summary / checkpoint
+        # intervals plus the loader's producer/consumer spans, riding the
+        # telemetry JSONL sink. 'off' (default) installs the shared
+        # disabled tracer: no span objects, no records, and — tracing
+        # being host-side only — the jitted programs are untouched either
+        # way (tested to the telemetry-off bit-identity standard).
+        self.tracer = tracing.NULL_TRACER
+        if cfg.tracing_level != "off" and self.telemetry.enabled:
+            self.tracer = tracing.Tracer(
+                emit=lambda **f: self.telemetry.event("span", **f)
+            )
+        # the loader's producer/consumer seams share the run tracer (the
+        # loader was constructed before telemetry existed)
+        self.data.tracer = self.tracer
         # elastic resume record (schema v6): a checkpoint written by a
         # different topology resumes deterministically — say so in the log
         # (old -> new process count + the episode-cursor re-entry point)
@@ -335,6 +358,24 @@ class ExperimentBuilder:
             on_event=lambda action, **f: self.telemetry.event(
                 "trace", action=action, **f
             ),
+        )
+        # on-demand device profiling: `echo N > logs/PROFILE_REQUEST` (or
+        # SIGUSR2) captures a jax.profiler trace of the NEXT N train
+        # dispatches into logs/profile_traces/ — no restart, no config
+        # change; the emitted trace records carry the causal-tracing
+        # trace_id so the device profile links to the host span timeline
+        self.ondemand_profiler = OnDemandProfiler(
+            os.path.join(self.logs_filepath, PROFILE_REQUEST_FILENAME),
+            os.path.join(self.logs_filepath, "profile_traces"),
+            default_steps=cfg.profile_num_steps,
+            on_event=lambda action, **f: self.telemetry.event(
+                "trace", action=action, **f
+            ),
+            # NULL_TRACER's id is a module-global shared by every run in
+            # the process — only a live tracer's id is run-scoped enough
+            # to link a device profile to this run's span timeline
+            trace_id=(self.tracer.trace_id
+                      if self.tracer.enabled else None),
         )
         # heartbeat hang watchdog: every host runs one (a multihost hang is
         # typically visible from every process except the one that caused
@@ -1174,7 +1215,14 @@ class ExperimentBuilder:
         # (pixel tuple — x_s, x_t, y_s, y_t leading — or IndexBatch)
         self._maybe_profile_step()
         self._beat("train_dispatch")
-        losses = self.model.run_train_iter(train_sample, epoch=epoch_idx)
+        # the span covers the ENQUEUE interval (the dispatch is
+        # asynchronous; the device executes under the one-step lag) —
+        # exactly the causal timeline reading, and zero added syncs
+        with self.tracer.span(
+            "train_dispatch", cat="train",
+            iter=int(self.state["current_iter"]), k=1,
+        ):
+            losses = self.model.run_train_iter(train_sample, epoch=epoch_idx)
         self._pop_dynamics(losses, 1)
         halt = self._pop_health(losses)
         self._accumulate(losses, self.total_losses)
@@ -1205,7 +1253,13 @@ class ExperimentBuilder:
             return
         self._maybe_profile_step()
         self._beat("train_dispatch")
-        losses = self.model.run_train_iters(list(train_samples), epoch=epoch_idx)
+        with self.tracer.span(
+            "train_dispatch", cat="train",
+            iter=int(self.state["current_iter"]), k=len(train_samples),
+        ):
+            losses = self.model.run_train_iters(
+                list(train_samples), epoch=epoch_idx
+            )
         self._pop_dynamics(losses, len(train_samples))
         halt = self._pop_health(losses)
         # ONE accumulation per chunk: device metrics arrive (k,)-stacked and
@@ -1229,8 +1283,12 @@ class ExperimentBuilder:
         """Scheduled trace capture: iterations [profile_start_step,
         profile_start_step + profile_num_steps) of ``profile_epoch``
         (-1 = this run's first steps; iteration 0 is compile, not steady
-        state) when ``profile_trace_dir`` is set — see TraceWindow."""
+        state) when ``profile_trace_dir`` is set — see TraceWindow. The
+        on-demand profiler polls its runtime trigger (logs/PROFILE_REQUEST
+        or SIGUSR2) unconditionally — live-incident capture needs no
+        config."""
         cfg = self.cfg
+        self.ondemand_profiler.step(sync=self._sync_device)
         if not cfg.profile_trace_dir:
             return
         it = int(self.state["current_iter"])
@@ -1243,7 +1301,8 @@ class ExperimentBuilder:
 
     def evaluation_iteration(self, val_sample, total_losses):
         self._beat("eval_dispatch")
-        losses, _ = self.model.run_validation_iter(val_sample)
+        with self.tracer.span("eval_chunk", cat="eval", k=1):
+            losses, _ = self.model.run_validation_iter(val_sample)
         self._accumulate(losses, total_losses)
 
     def evaluation_iterations(self, val_samples, total_losses):
@@ -1255,7 +1314,10 @@ class ExperimentBuilder:
             self.evaluation_iteration(val_samples[0], total_losses)
             return
         self._beat("eval_dispatch")
-        losses, _ = self.model.run_validation_iters(list(val_samples))
+        with self.tracer.span(
+            "eval_chunk", cat="eval", k=len(val_samples),
+        ):
+            losses, _ = self.model.run_validation_iters(list(val_samples))
         self._accumulate(losses, total_losses)
 
     def run_validation_epoch(
@@ -1303,11 +1365,20 @@ class ExperimentBuilder:
         if pre_summary_fn is not None:
             # the last eval chunk is still in flight (the system's
             # one-step-lag never blocks on the dispatch it just enqueued)
+            # — the epoch_summary span therefore OVERLAPS the in-flight
+            # eval tail on the trace timeline, which is the PR 11
+            # boundary overlap made visible as overlapping intervals
             t0 = time.perf_counter()
-            self._pre_summary_result = pre_summary_fn()
+            with self.tracer.span(
+                "epoch_summary", cat="train", epoch=int(self.epoch),
+            ):
+                self._pre_summary_result = pre_summary_fn()
             self._last_overlap_ms = (time.perf_counter() - t0) * 1e3
         # the one synchronization point: reduce the val metric stacks
-        return self.build_summary_dict(total_losses, "val")
+        with self.tracer.span(
+            "eval_sync", cat="eval", epoch=int(self.epoch),
+        ):
+            return self.build_summary_dict(total_losses, "val")
 
     def _stream_metrics(self) -> Dict[str, float]:
         """The loader producer's cumulative stats (episode assembly, queue
@@ -1345,7 +1416,7 @@ class ExperimentBuilder:
         for key, value in epoch_summary.items():
             self.state["per_epoch_statistics"].setdefault(key, []).append(value)
         epoch_summary["epoch"] = self.epoch
-        epoch_summary["epoch_run_time"] = time.time() - self.start_time
+        epoch_summary["epoch_run_time"] = time.perf_counter() - self.start_time
         if self.create_summary_csv:
             self._csv_keys = list(epoch_summary.keys())
             created = True
@@ -1376,7 +1447,7 @@ class ExperimentBuilder:
                     "the existing header, extra metrics appear in "
                     "summary_statistics.json / telemetry only"
                 )
-        self.start_time = time.time()
+        self.start_time = time.perf_counter()
         self._log(f"epoch {self.epoch} -> " + ", ".join(
             f"{k}: {v:.4f}" for k, v in epoch_summary.items()
             if "loss" in k or "accuracy" in k
@@ -1448,6 +1519,9 @@ class ExperimentBuilder:
         # duration of the run (previous handlers restored on every exit
         # path, so nested/test-harness use never leaks a handler)
         previous_handlers = self._install_signal_handlers()
+        # SIGUSR2 = "profile the next N dispatches" (main-thread runs
+        # only; the PROFILE_REQUEST file trigger works everywhere)
+        self.ondemand_profiler.install_signal_handler()
         if self.watchdog is not None:
             self.watchdog.start()
         try:
@@ -1465,10 +1539,14 @@ class ExperimentBuilder:
                 if previous_handlers is not None:
                     for sig, handler in previous_handlers.items():
                         signal.signal(sig, handler)
+                # SIGUSR2 too — the profiler handler closure would
+                # otherwise outlive the run (and its telemetry sink)
+                self.ondemand_profiler.uninstall_signal_handler()
                 # the trace only materialises at stop — don't lose it when
                 # the run ends/pauses/raises before profile_num_steps
-                # completes
+                # completes (scheduled and on-demand windows alike)
                 self.trace_window.close(self._sync_device)
+                self.ondemand_profiler.close(self._sync_device)
                 if self.watchdog is not None:
                     self.watchdog.stop()
                 # dynamics/health buffered since the last epoch flush
@@ -1597,13 +1675,16 @@ class ExperimentBuilder:
                     # backoff; an exhausted budget halts the run cleanly
                     # (RetriesExhaustedError) — training past a lost
                     # checkpoint would silently widen the crash window
-                    ckpt_path = self.retry.call(
-                        lambda: self.model.save_model(
-                            self.saved_models_filepath, int(self.epoch),
-                            self.state, also_latest=True,
-                        ),
-                        site="ckpt_save",
-                    )
+                    with self.tracer.span(
+                        "checkpoint", cat="train", epoch=int(self.epoch),
+                    ):
+                        ckpt_path = self.retry.call(
+                            lambda: self.model.save_model(
+                                self.saved_models_filepath, int(self.epoch),
+                                self.state, also_latest=True,
+                            ),
+                            site="ckpt_save",
+                        )
                     self._prune_consumed_emergency()
                     self.telemetry.event(
                         "checkpoint",

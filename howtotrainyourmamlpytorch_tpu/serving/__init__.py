@@ -30,13 +30,22 @@ request-driven hot path:
 """
 
 from .batcher import AdaptRequest, IndexRequest, MicroBatcher, serve_requests
-from .engine import ServingEngine, load_servable_snapshot
+from .engine import (
+    ServingEngine,
+    attach_serving_watchdog,
+    load_servable_snapshot,
+)
+from .metrics import FanoutSink, MetricsServer, ServingMetrics
 
 __all__ = [
     "AdaptRequest",
+    "FanoutSink",
     "IndexRequest",
+    "MetricsServer",
     "MicroBatcher",
     "ServingEngine",
+    "ServingMetrics",
+    "attach_serving_watchdog",
     "load_servable_snapshot",
     "serve_requests",
 ]

@@ -27,6 +27,7 @@ proves inert.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -129,13 +130,23 @@ def serve_requests(
 
 @dataclass
 class _Pending:
-    """A submitted request waiting for its dispatch."""
+    """A submitted request waiting for its dispatch.
+
+    ``span`` / ``queue_span`` (tracing on only): the request's root span
+    — opened at submit, closed when the future resolves — and its
+    ``queue`` child covering the micro-batcher wait. The worker thread
+    adopts the root as parent around the engine dispatch, so one
+    request's tree spans queue → assemble → dispatch → sync across
+    threads.
+    """
 
     request: AdaptRequest
     enqueued: float
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
+    span: Any = None
+    queue_span: Any = None
 
     def get(self, timeout: Optional[float] = None):
         """Block until the request was served; returns its
@@ -162,8 +173,11 @@ class MicroBatcher:
     """
 
     def __init__(self, engine, max_tenants: Optional[int] = None,
-                 max_wait_ms: Optional[float] = None):
+                 max_wait_ms: Optional[float] = None, metrics=None):
         self.engine = engine
+        # optional ServingMetrics (serving/metrics.py): the batcher
+        # reports its backlog as the serving_queue_depth gauge
+        self.metrics = metrics
         self.max_tenants = (
             engine.max_tenants if max_tenants is None
             else min(int(max_tenants), engine.max_tenants)
@@ -183,6 +197,7 @@ class MicroBatcher:
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
             )
         self._queues: Dict[int, List[_Pending]] = {}
+        self._request_ids = itertools.count(1)
         self._cond = threading.Condition()
         self._closed = False
         self._worker = threading.Thread(
@@ -197,11 +212,29 @@ class MicroBatcher:
         # shape error
         self.engine._validate(request)
         pending = _Pending(request=request, enqueued=time.perf_counter())
+        tracer = self.engine.tracer
+        if tracer.enabled:
+            # the request's causal root: request_id ties every stage of
+            # this request together across threads; closed when the
+            # future resolves (success, dispatch error, or close() sweep)
+            request_id = f"{tracer.trace_id}-r{next(self._request_ids):06d}"
+            pending.span = tracer.start_span(
+                "request", cat="serving", parent=None,
+                request_id=request_id, shots=request.shots,
+                tenant_id=getattr(request, "tenant_id", None),
+            )
+            pending.queue_span = tracer.start_span(
+                "queue", cat="serving", parent=pending.span,
+                shots=request.shots,
+            )
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._queues.setdefault(request.shots, []).append(pending)
+            depth = sum(len(q) for q in self._queues.values())
             self._cond.notify()
+        if self.metrics is not None:
+            self.metrics.observe_queue_depth(depth)
         return pending
 
     def close(self) -> None:
@@ -233,10 +266,13 @@ class MicroBatcher:
         with self._cond:
             leftovers = [p for q in self._queues.values() for p in q]
             self._queues.clear()
+        tracer = self.engine.tracer
         for p in leftovers:
             if not p.done.is_set():
                 p.error = error
                 p.done.set()
+                tracer.end_span(p.queue_span, outcome="failed")
+                tracer.end_span(p.span, outcome="failed")
 
     # -- worker ------------------------------------------------------------
 
@@ -295,25 +331,43 @@ class MicroBatcher:
         while True:
             with self._cond:
                 group = self._ripe_group()
+                depth = sum(len(q) for q in self._queues.values())
                 if group is None:
                     if self._closed:
                         return
                     self._cond.wait(timeout=self._next_deadline_s())
                     continue
+            if self.metrics is not None:
+                self.metrics.observe_queue_depth(depth)
             # dispatch OUTSIDE the lock: submit() stays non-blocking
             # while the device works
             now = time.perf_counter()
             queue_ms = float(
                 np.mean([(now - p.enqueued) * 1e3 for p in group])
             )
+            tracer = self.engine.tracer
+            for p in group:
+                # the queue wait ends here: the group is off its queue
+                # and about to assemble/dispatch
+                tracer.end_span(p.queue_span)
+                p.queue_span = None
             try:
-                dr = self.engine.serve_group(
-                    [p.request for p in group], queue_ms=queue_ms
-                )
+                # the first request's root span adopts the dispatch work:
+                # the engine's assemble/dispatch/sync spans (emitted on
+                # THIS worker thread) nest under a request, so at least
+                # one request's tree spans queue -> dispatch -> sync
+                with tracer.use_parent(group[0].span):
+                    dr = self.engine.serve_group(
+                        [p.request for p in group], queue_ms=queue_ms
+                    )
                 for p, res in zip(group, dr.results):
                     p.result = res
                     p.done.set()
+                    tracer.end_span(
+                        p.span, bucket=dr.bucket, outcome="served",
+                    )
             except BaseException as e:  # noqa: BLE001 - relayed to callers
                 for p in group:
                     p.error = e
                     p.done.set()
+                    tracer.end_span(p.span, outcome="error")

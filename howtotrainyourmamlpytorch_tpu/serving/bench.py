@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -216,7 +217,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "executables from here (zero XLA compiles) "
                              "and falls back to compile-then-save — see "
                              "cli serve-export")
+    parser.add_argument("--trace", action="store_true",
+                        help="emit schema-v10 span records (request/"
+                             "queue/assemble/dispatch/sync causal "
+                             "timeline) into the --telemetry log; render "
+                             "with `cli trace` (requires --telemetry)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve Prometheus text-format metrics on "
+                             "127.0.0.1:PORT for the duration of the run "
+                             "(0 = ephemeral port; the bound port lands "
+                             "in the JSON line as metrics_port)")
+    parser.add_argument("--profile-request", default=None, metavar="PATH",
+                        help="on-demand device profiling trigger file: "
+                             "writing a dispatch count to PATH mid-run "
+                             "captures a jax.profiler trace of the next "
+                             "N serving dispatches (see utils.profiling."
+                             "OnDemandProfiler)")
     args = parser.parse_args(argv)
+    if args.trace and not args.telemetry:
+        parser.error("--trace requires --telemetry: span records ride "
+                     "the telemetry JSONL sink")
     if not 0.0 <= args.repeat_tenant_fraction <= 1.0:
         parser.error("--repeat-tenant-fraction must be in [0, 1]")
     if args.checkpoint and not args.config:
@@ -246,10 +267,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         state = maml.init_state(cfg)
 
     sink = None
+    metrics = None
+    metrics_server = None
     if args.telemetry:
         from ..telemetry.sinks import JsonlSink
 
         sink = JsonlSink(args.telemetry)
+    if args.metrics_port is not None:
+        # the metrics registry is a telemetry sink teed off the same
+        # record stream the JSONL gets — endpoint and log cannot disagree
+        from .metrics import FanoutSink, MetricsServer, ServingMetrics
+
+        metrics = ServingMetrics()
+        sink = FanoutSink(sink, metrics) if sink is not None else metrics
+        metrics_server = MetricsServer(metrics, port=args.metrics_port)
+        print(f"serve-bench: metrics at {metrics_server.url}",
+              file=sys.stderr, flush=True)
+
+    tracer = None
+    if args.trace:
+        from ..telemetry.sinks import make_record
+        from ..telemetry.tracing import Tracer
+
+        span_sink = sink
+
+        def _emit(**fields):
+            span_sink.write(make_record("span", **fields))
+
+        tracer = Tracer(emit=_emit)
+
+    profiler = None
+    if args.profile_request:
+        from ..utils.profiling import OnDemandProfiler
+
+        profiler = OnDemandProfiler(
+            args.profile_request,
+            os.path.dirname(os.path.abspath(args.profile_request))
+            or ".",
+            trace_id=tracer.trace_id if tracer is not None else None,
+        )
 
     ingest = args.ingest or cfg.serving_ingest
     cache_size = args.cache_size
@@ -264,8 +320,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     engine = ServingEngine(
         cfg, state, shots_buckets=shots_buckets, sink=sink,
         strict_retrace=True, ingest=ingest, store=store,
-        cache_size=cache_size,
+        cache_size=cache_size, tracer=tracer, profiler=profiler,
     )
+    watchdog = None
+    if cfg.watchdog_timeout_s > 0:
+        # a wedged serving dispatch must produce a watchdog_stall record,
+        # not a silent hang — same contract as the train loop
+        from .engine import attach_serving_watchdog
+
+        watchdog = attach_serving_watchdog(
+            engine, cfg.watchdog_timeout_s, sink=sink,
+        )
     warmup_s = engine.warmup(artifact_dir=args.export_dir)
 
     groups = _synth_groups(
@@ -277,6 +342,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         serve_requests(engine, group)
 
     rollup = engine.rollup()
+    if profiler is not None:
+        profiler.close()
+    if watchdog is not None:
+        watchdog.stop()
+    if metrics_server is not None:
+        metrics_server.close()
     if sink is not None:
         sink.close()
     line = {
@@ -292,6 +363,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "tenants": rollup["tenants"],
         "retraces": rollup["retraces"],
         "warmup_seconds": round(warmup_s, 3),
+        # the latency decomposition (schema v10): queue wait + host batch
+        # assembly + device dispatch enqueue + blocking sync account for
+        # the end-to-end latency (adapt = dispatch + sync by definition)
+        "queue_ms_p50": rollup["queue_ms_p50"],
+        "batch_ms_mean": rollup["batch_ms_mean"],
+        "dispatch_ms_p50": rollup["dispatch_ms_p50"],
+        "sync_ms_p50": rollup["sync_ms_p50"],
+        "metrics_port": (
+            metrics_server.port if metrics_server is not None else None
+        ),
+        "traced": bool(args.trace),
         # the fast-path acceptance surface: measured H2D per dispatch
         # (the ingest tiers' ratio is the bench's 4x/index claim), cache
         # hit rate, and how warmup materialized its programs (the AOT

@@ -53,14 +53,21 @@ contract the auditor checks), the engine re-binds its reference after
 every dispatch, and the snapshot stays single-buffered in HBM like the
 train family's state.
 
-Telemetry: every dispatch emits a schema-v9 ``serving`` record
+Telemetry: every dispatch emits a schema-v10 ``serving`` record
 (event='dispatch': tenants, bucket, shots, queue_ms, adapt_ms, program,
-ingest, ingest_bytes, cache_hits) through ``telemetry.sinks.make_record``
+ingest, ingest_bytes, cache_hits — and the latency decomposition
+batch_ms / dispatch_ms / sync_ms, which with queue_ms accounts for the
+end-to-end request latency) through ``telemetry.sinks.make_record``
 into an optional sink; warmup emits an event='warmup' record (mode,
 warmup_ms, xla_compiles); ``rollup()`` condenses the run into an
 event='rollup' record (adapt_ms p50/p95, tenants_per_sec,
-h2d_bytes_per_dispatch, cache_hit_rate) — the line ``cli inspect
-summary`` prints jax-free.
+h2d_bytes_per_dispatch, cache_hit_rate, batch/dispatch/sync
+decomposition) — the line ``cli inspect summary`` prints jax-free,
+with a per-(program, bucket, shots) breakdown. With a ``tracer``
+attached, every dispatch additionally emits ``cache_lookup`` /
+``assemble`` / ``dispatch`` / ``sync`` / ``realign`` spans
+(telemetry/tracing.py) that ``cli trace`` renders as a Perfetto
+timeline.
 """
 
 from __future__ import annotations
@@ -75,6 +82,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import MAMLConfig
+from ..telemetry import tracing
 
 
 @dataclass
@@ -115,6 +123,13 @@ class DispatchResult:
     # the LABELED tenants (0 when the dispatch carried none)
     cache_hits: int = 0
     ingest_bytes: int = 0  # actual H2D payload bytes of the dispatches
+    # the latency decomposition (schema v10): host batch assembly, device
+    # dispatch enqueue, and host-blocking result fetch — with queue_ms
+    # they sum to the end-to-end latency a request observed
+    # (adapt_ms == dispatch_ms + sync_ms by construction)
+    batch_ms: float = 0.0
+    dispatch_ms: float = 0.0
+    sync_ms: float = 0.0
 
 
 def load_servable_snapshot(
@@ -198,6 +213,20 @@ class ServingEngine:
         adapted-params cache key (default: a content hash of the state —
         two engines over the same snapshot agree, a new checkpoint
         invalidates every cached tenant by construction).
+    :param tracer: a ``telemetry.tracing.Tracer`` — when enabled, every
+        dispatch emits ``assemble`` / ``dispatch`` / ``sync`` spans (the
+        latency decomposition) plus a ``cache_lookup`` span, all
+        host-side perf_counter intervals: tracing never adds a device
+        sync and the compiled programs are independent of it by
+        construction. Default: the shared disabled tracer.
+    :param watchdog: a started ``telemetry.Watchdog`` — beaten once per
+        device dispatch, so a wedged serving dispatch produces a
+        ``watchdog_stall`` diagnostic instead of a silent hang (see
+        ``attach_serving_watchdog``).
+    :param profiler: a ``utils.profiling.OnDemandProfiler`` — polled
+        once per (non-warmup) dispatch, so an operator can capture a
+        ``jax.profiler`` trace of the next N serving dispatches by
+        touching the trigger file, with no restart.
     """
 
     #: latency-sample window for the rollup percentiles (last N
@@ -215,6 +244,9 @@ class ServingEngine:
         store=None,
         cache_size: Optional[int] = None,
         snapshot_id: Optional[str] = None,
+        tracer: Optional[tracing.Tracer] = None,
+        watchdog=None,
+        profiler=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -294,6 +326,14 @@ class ServingEngine:
                 f"(this engine is ingest={self.ingest!r})"
             )
         self.retrace_detector = RetraceDetector(strict=strict_retrace)
+        self.tracer = tracer if tracer is not None else tracing.NULL_TRACER
+        self.watchdog = watchdog
+        self.profiler = profiler
+        # warmup dispatches are compile/prime traffic: excluded from the
+        # rollup already, and excluded from spans/profiling so a timeline
+        # or an on-demand profile never mistakes the compile bill for
+        # steady-state latency
+        self._warming = False
         # a dispatch that fails AFTER donation leaves self._state pointing
         # at deleted buffers; the engine marks itself dead with the root
         # cause so later requests fail fast naming it, instead of a
@@ -335,6 +375,11 @@ class ServingEngine:
         self._adapt_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self._queue_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self._h2d_bytes: Deque[int] = deque(maxlen=self.LATENCY_WINDOW)
+        # the latency decomposition's per-dispatch samples (schema v10):
+        # host batch assembly / device dispatch enqueue / blocking fetch
+        self._batch_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        self._dispatch_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        self._sync_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self._tenants_served = 0
         self._span_start: Optional[float] = None
         self._span_end: Optional[float] = None
@@ -590,6 +635,7 @@ class ServingEngine:
             artifact_dir = self.cfg.serving_export_dir or None
         start = time.perf_counter()
         compiles0 = export_lib.xla_compile_count()
+        self._warming = True
         cache_on = self.cache_size > 0
         names = self._program_names()
         extra = (
@@ -637,6 +683,7 @@ class ServingEngine:
                     "predict", bucket, 0,
                     self._predict_args([], [], bucket),
                 )
+        self._warming = False
         seconds = time.perf_counter() - start
         self.warmup_stats = {
             "mode": mode,
@@ -655,9 +702,13 @@ class ServingEngine:
     # -- dispatch ----------------------------------------------------------
 
     def _raw_dispatch(self, family: str, bucket: int, shots: int, args):
-        """One device dispatch; returns (out, adapt_ms). ``adapt_ms`` is
-        enqueue-to-host-fetch: it includes the H2D upload and the result
-        readback — the latency a caller actually observes.
+        """One device dispatch; returns ``(out, adapt_ms, dispatch_ms,
+        sync_ms)``. ``adapt_ms`` is enqueue-to-host-fetch: it includes
+        the H2D upload and the result readback — the latency a caller
+        actually observes; ``dispatch_ms`` is the asynchronous enqueue
+        (program call return), ``sync_ms`` the host-blocking fetch of
+        every output — the two halves sum to ``adapt_ms``, which is what
+        makes the serving latency decomposition add up.
 
         A failure in here (device error, OOM, interrupt mid-readback) is
         TERMINAL for the engine: the dispatch may already have consumed
@@ -671,13 +722,23 @@ class ServingEngine:
                 "the state was donated (root cause chained below); build "
                 "a fresh engine from the snapshot"
             ) from self._dead
+        site = self._site(family, bucket, shots)
+        if self.watchdog is not None:
+            # one beat per dispatch: a wedged dispatch stalls the beat
+            # stream and the watchdog names this site in its diagnostic
+            self.watchdog.beat(site)
+        if self.profiler is not None and not self._warming:
+            # on-demand device profiling: the trigger file / SIGUSR2 arms
+            # a jax.profiler window over the next N dispatches
+            self.profiler.step()
         prog = self._program(family, bucket, shots)
-        self.retrace_detector.observe(
-            self._site(family, bucket, shots), args
-        )
+        self.retrace_detector.observe(site, args)
+        tracer = self.tracer if not self._warming else tracing.NULL_TRACER
+        span_attrs = {"program": family, "bucket": bucket, "shots": shots}
         start = time.perf_counter()
         try:
             new_state, out = prog(*args)
+            enqueued = time.perf_counter()
             # host-fetch every output the caller reads: the one sync that
             # provably blocks on every backend (see bench.py's sync note)
             fetched = {
@@ -696,11 +757,25 @@ class ServingEngine:
         except BaseException as e:
             self._dead = e
             raise
-        adapt_ms = (time.perf_counter() - start) * 1e3
+        end = time.perf_counter()
+        adapt_ms = (end - start) * 1e3
+        dispatch_ms = (enqueued - start) * 1e3
+        sync_ms = (end - enqueued) * 1e3
+        if tracer.enabled:
+            # emit the dispatch/sync spans from the stamps, AFTER the
+            # timed interval: the span records' own serialization and
+            # sink write must never inflate the decomposition (or the
+            # SLO adapt_ms) they exist to report
+            sp = tracer.start_span("dispatch", cat="serving",
+                                   start_ms=start * 1e3, **span_attrs)
+            tracer.end_span(sp, end_ms=enqueued * 1e3)
+            sp = tracer.start_span("sync", cat="serving",
+                                   start_ms=enqueued * 1e3, **span_attrs)
+            tracer.end_span(sp, end_ms=end * 1e3)
         # re-bind: the old state buffers were donated to (and alias) the
         # returned state — the previous reference is dead
         self._state = new_state
-        return fetched, adapt_ms
+        return fetched, adapt_ms, dispatch_ms, sync_ms
 
     def _adapt_args(self, requests, bucket: int, shots: int):
         """Assemble one adapt dispatch's args for this ingest tier."""
@@ -845,20 +920,24 @@ class ServingEngine:
         hit_fasts: List[Dict[str, np.ndarray]] = []
         miss_idx: List[int] = list(range(n_real))
         if cache_on:
-            keys = [self._cache_key(r, shots) for r in requests]
-            hit_idx, miss_idx = [], []
-            for i, key in enumerate(keys):
-                if key in self._cache:
-                    self._cache.move_to_end(key)
-                    hit_idx.append(i)
-                    # snapshot the fast weights NOW: inserting this
-                    # group's misses below may evict the hit entries
-                    # from a small LRU before the predict dispatch reads
-                    # them (entries are immutable once inserted, so the
-                    # reference stays valid past eviction)
-                    hit_fasts.append(self._cache[key])
-                else:
-                    miss_idx.append(i)
+            with self.tracer.span(
+                "cache_lookup", cat="serving", shots=shots, tenants=n_real,
+            ):
+                keys = [self._cache_key(r, shots) for r in requests]
+                hit_idx, miss_idx = [], []
+                for i, key in enumerate(keys):
+                    if key in self._cache:
+                        self._cache.move_to_end(key)
+                        hit_idx.append(i)
+                        # snapshot the fast weights NOW: inserting this
+                        # group's misses below may evict the hit entries
+                        # from a small LRU before the predict dispatch
+                        # reads them (entries are immutable once
+                        # inserted, so the reference stays valid past
+                        # eviction)
+                        hit_fasts.append(self._cache[key])
+                    else:
+                        miss_idx.append(i)
             self.cache_hits += len(hit_idx)
             self.cache_misses += len(miss_idx)
         if self._span_start is None:
@@ -866,44 +945,82 @@ class ServingEngine:
         results: List[Optional[TenantResult]] = [None] * n_real
         total_ms = 0.0
         total_h2d = 0
+        total_batch_ms = 0.0
+        total_dispatch_ms = 0.0
+        total_sync_ms = 0.0
         metric_parts: List[Tuple[Dict[str, float], int]] = []
         bucket: Optional[int] = None
 
-        def _fill(idxs, out, adapt_ms, args, program, dispatch_bucket):
+        def _assemble(program, dispatch_bucket, fn):
+            """Time (and span) one dispatch's host batch assembly."""
+            with self.tracer.span(
+                "assemble", cat="serving", program=program,
+                bucket=dispatch_bucket, shots=shots,
+            ):
+                t0 = time.perf_counter()
+                args = fn()
+                return args, (time.perf_counter() - t0) * 1e3
+
+        def _fill(idxs, out, timings, args, program, dispatch_bucket,
+                  batch_ms):
             nonlocal total_ms, total_h2d, bucket
+            nonlocal total_batch_ms, total_dispatch_ms, total_sync_ms
+            adapt_ms, dispatch_ms, sync_ms = timings
             h2d = self._args_h2d_bytes(args)
             total_ms += adapt_ms
             total_h2d += h2d
+            total_batch_ms += batch_ms
+            total_dispatch_ms += dispatch_ms
+            total_sync_ms += sync_ms
             if bucket is None or program == "adapt":
                 bucket = dispatch_bucket
             labeled_count = 0
-            for j, i in enumerate(idxs):
-                req = requests[i]
-                lab = self._labeled_of(req)
-                labeled_count += int(lab)
-                results[i] = TenantResult(
-                    tenant_id=getattr(req, "tenant_id", None),
-                    preds=out["preds"][j],
-                    loss=float(out["loss"][j]) if lab else None,
-                    accuracy=float(out["accuracy"][j]) if lab else None,
-                )
+            with self.tracer.span(
+                "realign", cat="serving", program=program,
+                bucket=dispatch_bucket, shots=shots,
+            ):
+                for j, i in enumerate(idxs):
+                    req = requests[i]
+                    lab = self._labeled_of(req)
+                    labeled_count += int(lab)
+                    results[i] = TenantResult(
+                        tenant_id=getattr(req, "tenant_id", None),
+                        preds=out["preds"][j],
+                        loss=float(out["loss"][j]) if lab else None,
+                        accuracy=float(out["accuracy"][j]) if lab else None,
+                    )
             metric_parts.append((out["metrics"], labeled_count))
             self._adapt_ms.append(adapt_ms)
             self._h2d_bytes.append(h2d)
-            self._record(
+            self._batch_ms.append(batch_ms)
+            self._dispatch_ms.append(dispatch_ms)
+            self._sync_ms.append(sync_ms)
+            fields = dict(
                 event="dispatch", tenants=len(idxs),
                 bucket=dispatch_bucket, shots=shots,
                 queue_ms=round(float(queue_ms), 3),
                 adapt_ms=round(adapt_ms, 3), program=program,
                 ingest=self.ingest, ingest_bytes=h2d,
-                cache_hits=len(idxs) if program == "predict" else 0,
+                batch_ms=round(batch_ms, 3),
+                dispatch_ms=round(dispatch_ms, 3),
+                sync_ms=round(sync_ms, 3),
             )
+            if self.cache_size > 0:
+                # present only when the cache exists, so downstream
+                # hit-rate quotients (metrics endpoint) agree with the
+                # rollup's cache_hit_rate=None on cache-less engines
+                fields["cache_hits"] = (
+                    len(idxs) if program == "predict" else 0
+                )
+            self._record(**fields)
 
         if miss_idx:
             group = [requests[i] for i in miss_idx]
             b = _bucket_for(len(group), self.buckets)
-            args = self._adapt_args(group, b, shots)
-            out, adapt_ms = self._raw_dispatch("adapt", b, shots, args)
+            args, batch_ms = _assemble(
+                "adapt", b, lambda: self._adapt_args(group, b, shots)
+            )
+            out, *timings = self._raw_dispatch("adapt", b, shots, args)
             if cache_on and "adapted" in out:
                 for j, i in enumerate(miss_idx):
                     self._cache_insert(
@@ -911,13 +1028,16 @@ class ServingEngine:
                         {k: np.array(v[j])
                          for k, v in out["adapted"].items()},
                     )
-            _fill(miss_idx, out, adapt_ms, args, "adapt", b)
+            _fill(miss_idx, out, timings, args, "adapt", b, batch_ms)
         if hit_idx:
             group = [requests[i] for i in hit_idx]
             b = _bucket_for(len(group), self.buckets)
-            args = self._predict_args(hit_fasts, group, b)
-            out, adapt_ms = self._raw_dispatch("predict", b, 0, args)
-            _fill(hit_idx, out, adapt_ms, args, "predict", b)
+            args, batch_ms = _assemble(
+                "predict", b,
+                lambda: self._predict_args(hit_fasts, group, b),
+            )
+            out, *timings = self._raw_dispatch("predict", b, 0, args)
+            _fill(hit_idx, out, timings, args, "predict", b, batch_ms)
         self._span_end = time.perf_counter()
         self._queue_ms.append(float(queue_ms))
         self._tenants_served += n_real
@@ -939,6 +1059,9 @@ class ServingEngine:
             queue_ms=float(queue_ms), adapt_ms=total_ms,
             metrics=metrics, cache_hits=len(hit_idx),
             ingest_bytes=total_h2d,
+            batch_ms=total_batch_ms,
+            dispatch_ms=total_dispatch_ms,
+            sync_ms=total_sync_ms,
         )
 
     # -- telemetry ---------------------------------------------------------
@@ -966,6 +1089,9 @@ class ServingEngine:
         adapt = np.asarray(self._adapt_ms, np.float64)
         queue = np.asarray(self._queue_ms, np.float64)
         h2d = np.asarray(self._h2d_bytes, np.float64)
+        batch = np.asarray(self._batch_ms, np.float64)
+        disp = np.asarray(self._dispatch_ms, np.float64)
+        syncs = np.asarray(self._sync_ms, np.float64)
         span_s = (
             self._span_end - self._span_start
             if self._span_start is not None and self._span_end is not None
@@ -989,6 +1115,21 @@ class ServingEngine:
                 round(float(np.percentile(queue, 50)), 3) if queue.size
                 else None
             ),
+            # the latency decomposition (schema v10): with queue_ms these
+            # account for a request's whole end-to-end latency —
+            # queue + batch + dispatch + sync ≈ e2e (tested within
+            # tolerance); adapt_ms == dispatch_ms + sync_ms exactly
+            "batch_ms_mean": (
+                round(float(np.mean(batch)), 3) if batch.size else None
+            ),
+            "dispatch_ms_p50": (
+                round(float(np.percentile(disp, 50)), 3) if disp.size
+                else None
+            ),
+            "sync_ms_p50": (
+                round(float(np.percentile(syncs, 50)), 3) if syncs.size
+                else None
+            ),
             "tenants_per_sec": (
                 round(self._tenants_served / span_s, 3)
                 if span_s > 0
@@ -1004,6 +1145,68 @@ class ServingEngine:
         }
         self._record(event="rollup", **out)
         return out
+
+
+def attach_serving_watchdog(engine: "ServingEngine", timeout_s: float,
+                            sink=None, recorder=None):
+    """Wire the hang ``Watchdog`` to a serving engine and start it.
+
+    The engine beats the watchdog once per device dispatch
+    (``_raw_dispatch``); when a dispatch wedges — a stuck collective, a
+    hung device transport — the stall produces the SAME forensic surface
+    a wedged train loop gets: one loud stderr line, a schema-valid
+    ``watchdog_stall`` telemetry record (into ``sink``, when given,
+    carrying the stage = the wedged dispatch site, all-thread stacks and
+    the flight-recorder tail) and a flight-recorder incident directory
+    (``recorder``, when given) surfaced as an ``incident`` record.
+    Returns the STARTED watchdog; callers own ``stop()``.
+    """
+    import sys as _sys
+
+    from ..telemetry.sinks import make_record
+    from ..telemetry.watchdog import Watchdog
+
+    def on_stall(record):
+        print(
+            f"[serving-watchdog] no dispatch progress for "
+            f"{record['seconds_since_progress']:.1f}s "
+            f"(stage={record['stage']!r}, beats={record['beat_count']})",
+            file=_sys.stderr,
+            flush=True,
+        )
+        context = {}
+        if recorder is not None:
+            context["recorder_tail"] = recorder.snapshot()[-8:]
+        if sink is not None:
+            sink.write(make_record("watchdog_stall", **record, **context))
+        if recorder is not None:
+            try:
+                path = recorder.dump(
+                    "watchdog_stall",
+                    0,  # serving has no train iteration counter
+                    details={
+                        "stage": record["stage"],
+                        "seconds_since_progress":
+                            record["seconds_since_progress"],
+                        "beat_count": record["beat_count"],
+                    },
+                    state_dump_fn=None,
+                    force=True,
+                )
+            except Exception as e:  # noqa: BLE001 - forensics must never
+                # kill the serving process they document
+                print(f"[serving-watchdog] ring dump failed: {e!r}",
+                      file=_sys.stderr, flush=True)
+                path = None
+            if path is not None and sink is not None:
+                sink.write(make_record(
+                    "incident", iter=0, reason="watchdog_stall", path=path,
+                ))
+
+    watchdog = Watchdog(timeout_s, on_stall=on_stall)
+    engine.watchdog = watchdog
+    watchdog.start()
+    return watchdog
 
 
 def resolve_serving_cache_dir(cfg: MAMLConfig,

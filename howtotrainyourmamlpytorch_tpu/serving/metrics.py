@@ -1,0 +1,355 @@
+"""Live serving metrics: a Prometheus text-format endpoint (stdlib only).
+
+Production serving needs a scrape surface, not just a JSONL log. This
+module aggregates the SAME schema-v10 ``serving`` telemetry records the
+engine already emits — ``ServingMetrics`` is itself a telemetry sink, so
+it tees off the record stream (``FanoutSink``) with zero new
+instrumentation in the hot path and by construction can never disagree
+with the JSONL rollup — and serves them over a background
+``http.server`` thread in Prometheus exposition text format (0.0.4):
+
+* ``serving_requests_total`` (tenants served), ``serving_dispatches_total``
+  (labelled by ``program``), ``serving_retraces_total``;
+* ``serving_cache_hits_total`` / ``serving_cache_lookups_total`` (hit
+  rate = the quotient, consistent with the rollup's ``cache_hit_rate``);
+* ``serving_h2d_bytes_total`` — cumulative actual H2D payload;
+* ``serving_adapt_latency_ms`` / ``serving_queue_latency_ms`` histograms
+  (cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` series — the
+  p50/p95 the rollup quotes are recoverable from the same buckets);
+* ``serving_queue_depth`` gauge (the micro-batcher's last observed
+  backlog, when a batcher reports it).
+
+Usage (what ``cli serve-bench --metrics-port`` wires)::
+
+    metrics = ServingMetrics()
+    sink = FanoutSink(JsonlSink(path), metrics)
+    engine = ServingEngine(cfg, state, sink=sink)
+    server = MetricsServer(metrics, port=9090)   # port=0 picks a free one
+    ...
+    server.close()
+
+Pure stdlib — importable (and scrapeable) without jax or numpy.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "Histogram",
+    "ServingMetrics",
+    "FanoutSink",
+    "MetricsServer",
+]
+
+#: latency histogram upper bounds (milliseconds) — spanning sub-ms CPU
+#: predict dispatches to multi-second cold compiles
+DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+def _fmt(value: float) -> str:
+    """Prometheus number formatting: integral floats without the dot."""
+    if value == float("inf"):
+        return "+Inf"
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+class Histogram:
+    """A cumulative Prometheus histogram (counts per le-bucket + sum)."""
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
+        self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)  # +Inf last
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += float(value)
+        for i, bound in enumerate(self.bounds):
+            if value <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, help_text: str) -> List[str]:
+        lines = [
+            f"# HELP {name} {help_text}",
+            f"# TYPE {name} histogram",
+        ]
+        cumulative = 0
+        for bound, n in zip(
+            self.bounds + (float("inf"),), self.counts
+        ):
+            cumulative += n
+            lines.append(
+                f'{name}_bucket{{le="{_fmt(bound)}"}} {cumulative}'
+            )
+        lines.append(f"{name}_sum {_fmt(round(self.total, 6))}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
+
+
+class ServingMetrics:
+    """Aggregates ``serving`` telemetry records into scrapeable series.
+
+    Sink-compatible (``write(record)``): hand it to the engine directly,
+    or tee it next to the JSONL sink with ``FanoutSink`` — one record
+    stream, two consumers, so the endpoint and the log can never
+    disagree. Thread-safe: the engine's dispatch thread writes while the
+    HTTP thread renders.
+    """
+
+    def __init__(self,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.dispatches_by_program: Dict[str, int] = {}
+        self.cache_hits_total = 0
+        self.cache_lookups_total = 0
+        self.h2d_bytes_total = 0
+        self.retraces_total = 0
+        self.warmups_total = 0
+        self.queue_depth = 0
+        self.adapt_ms = Histogram(buckets)
+        self.queue_ms = Histogram(buckets)
+
+    # -- the sink face -----------------------------------------------------
+
+    def write(self, record: Dict[str, Any]) -> None:
+        """Consume one telemetry record (non-serving kinds pass through
+        untouched — the tee carries the whole stream)."""
+        if not isinstance(record, dict) or record.get("kind") != "serving":
+            return
+        event = record.get("event")
+        with self._lock:
+            if event == "dispatch":
+                tenants = record.get("tenants")
+                if isinstance(tenants, int):
+                    self.requests_total += tenants
+                program = str(record.get("program", "adapt"))
+                self.dispatches_by_program[program] = (
+                    self.dispatches_by_program.get(program, 0) + 1
+                )
+                # dispatch records carry cache_hits only when the
+                # adapted-params cache is enabled — a cache-less engine
+                # must render 0 lookups (rollup: cache_hit_rate=None),
+                # not a 0% hit rate
+                hits = record.get("cache_hits")
+                if isinstance(hits, int):
+                    self.cache_hits_total += hits
+                    if isinstance(tenants, int):
+                        self.cache_lookups_total += tenants
+                nbytes = record.get("ingest_bytes")
+                if isinstance(nbytes, int):
+                    self.h2d_bytes_total += nbytes
+                adapt = record.get("adapt_ms")
+                if isinstance(adapt, (int, float)):
+                    self.adapt_ms.observe(float(adapt))
+                queue = record.get("queue_ms")
+                if isinstance(queue, (int, float)):
+                    self.queue_ms.observe(float(queue))
+            elif event == "rollup":
+                retraces = record.get("retraces")
+                if isinstance(retraces, int):
+                    self.retraces_total = retraces
+            elif event == "warmup":
+                self.warmups_total += 1
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+
+    def close(self) -> None:  # sink protocol completeness
+        pass
+
+    # -- exposition --------------------------------------------------------
+
+    def render(self) -> str:
+        """The Prometheus text-format (0.0.4) payload."""
+        with self._lock:
+            lines: List[str] = []
+
+            def counter(name: str, help_text: str, value: float) -> None:
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(value)}")
+
+            counter("serving_requests_total",
+                    "Tenants served (cache hits included)",
+                    self.requests_total)
+            lines.append(
+                "# HELP serving_dispatches_total Device dispatches by "
+                "program family"
+            )
+            lines.append("# TYPE serving_dispatches_total counter")
+            for program in sorted(self.dispatches_by_program):
+                lines.append(
+                    f'serving_dispatches_total{{program="{program}"}} '
+                    f"{self.dispatches_by_program[program]}"
+                )
+            counter("serving_cache_hits_total",
+                    "Adapted-params cache hits (tenants that skipped the "
+                    "inner loop)",
+                    self.cache_hits_total)
+            counter("serving_cache_lookups_total",
+                    "Adapted-params cache lookups (tenants through "
+                    "dispatches)",
+                    self.cache_lookups_total)
+            counter("serving_h2d_bytes_total",
+                    "Actual host-to-device payload bytes uploaded",
+                    self.h2d_bytes_total)
+            counter("serving_retraces_total",
+                    "Mid-run recompiles the strict detector observed "
+                    "(0 in any healthy run)",
+                    self.retraces_total)
+            counter("serving_warmups_total",
+                    "Engine warmups observed", self.warmups_total)
+            lines.append(
+                "# HELP serving_queue_depth Micro-batcher backlog "
+                "(requests queued across shots buckets)"
+            )
+            lines.append("# TYPE serving_queue_depth gauge")
+            lines.append(f"serving_queue_depth {self.queue_depth}")
+            lines += self.adapt_ms.render(
+                "serving_adapt_latency_ms",
+                "End-to-end dispatch latency (upload + device + readback)",
+            )
+            lines += self.queue_ms.render(
+                "serving_queue_latency_ms",
+                "Micro-batcher queue wait per dispatch",
+            )
+            return "\n".join(lines) + "\n"
+
+
+class FanoutSink:
+    """Tee one telemetry record stream into several sinks (JSONL log +
+    metrics registry is the serving shape). Write errors in one sink
+    must not starve the others."""
+
+    def __init__(self, *sinks):
+        self.sinks = [s for s in sinks if s is not None]
+
+    def write(self, record: Dict[str, Any]) -> None:
+        # every sink sees every record even when an earlier one raises
+        # (a full JSONL disk must not blind the metrics endpoint); the
+        # first error still surfaces after delivery, same as a lone sink
+        first_error: Optional[BaseException] = None
+        for sink in self.sinks:
+            try:
+                sink.write(record)
+            except Exception as e:  # noqa: BLE001 - per-sink isolation
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+    def close(self) -> None:
+        first_error: Optional[BaseException] = None
+        for sink in self.sinks:
+            close = getattr(sink, "close", None)
+            if close is None:
+                continue
+            try:
+                close()
+            except Exception as e:  # noqa: BLE001 - per-sink isolation
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+
+class _Handler(BaseHTTPRequestHandler):
+    metrics: ServingMetrics  # set per server class below
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path.split("?")[0] in ("/metrics", "/"):
+            body = self.metrics.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/healthz":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(b"ok\n")
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, fmt, *args):  # silence per-scrape stderr spam
+        pass
+
+
+class MetricsServer:
+    """Background-thread HTTP server exposing ``/metrics`` (+
+    ``/healthz``). ``port=0`` binds an ephemeral port — read ``.port``
+    after construction. ``close()`` shuts the server down and joins the
+    thread; the server thread is a daemon either way, so a crashed
+    serving process never hangs on it."""
+
+    def __init__(self, metrics: ServingMetrics, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.metrics = metrics
+
+        class _BoundHandler(_Handler):
+            pass
+
+        _BoundHandler.metrics = metrics
+        self._httpd = ThreadingHTTPServer((host, port), _BoundHandler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="serving-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(5.0)
+        self._httpd.server_close()
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
+    """Parse exposition text into ``{metric_name: {labels_blob: value}}``
+    (``labels_blob`` '' for unlabelled series). Used by the tests and the
+    CI trace-smoke job to assert the endpoint speaks valid text format —
+    a parse error raises ValueError naming the line."""
+    out: Dict[str, Dict[str, float]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+            if "{" in series:
+                name, labels = series.split("{", 1)
+                if not labels.endswith("}"):
+                    raise ValueError("unterminated label set")
+                labels = labels[:-1]
+            else:
+                name, labels = series, ""
+            out.setdefault(name, {})[labels] = float(value)
+        except ValueError as e:
+            raise ValueError(
+                f"prometheus text line {lineno} unparseable: {line!r} ({e})"
+            ) from e
+    return out

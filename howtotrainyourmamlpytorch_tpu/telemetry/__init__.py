@@ -35,4 +35,13 @@ from .sinks import (  # noqa: F401
     TensorBoardSink,
     make_record,
 )
+from .tracing import (  # noqa: F401
+    NULL_TRACER,
+    Span,
+    Tracer,
+    critical_path_summary,
+    new_trace_id,
+    span_records,
+    to_chrome_trace,
+)
 from .watchdog import Watchdog, thread_stacks  # noqa: F401

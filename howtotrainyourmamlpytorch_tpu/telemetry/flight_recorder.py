@@ -88,7 +88,11 @@ class FlightRecorder:
         if not self.enabled:
             return
         with self._lock:
-            self._ring.append({"event": kind, "ts": time.time(), **payload})
+            self._ring.append({
+                "event": kind,
+                "ts": time.time(),  # lint-ok: MP007 wall-clock timestamp correlating ring entries with external logs
+                **payload,
+            })
 
     def snapshot(self) -> List[Dict[str, Any]]:
         """The ring's current contents, oldest first."""
@@ -164,7 +168,7 @@ class FlightRecorder:
         manifest = {
             "reason": reason,
             "iter": iter_idx,
-            "ts": time.time(),
+            "ts": time.time(),  # lint-ok: MP007 wall-clock timestamp in the incident manifest
             "ring_entries": len(ring),
             "state_dumped": bool(dump_state and state_error is None),
             "state_error": state_error,

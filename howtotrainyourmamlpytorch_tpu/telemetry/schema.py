@@ -92,7 +92,19 @@ Record kinds:
   and — when the SPMD audit ran — the flagship train step's static
   ``roofline`` summary (bound, predicted HFU/MFU, flops/task), so
   ``cli inspect summary`` can say where the MFU number goes without the
-  run's stdout.
+  run's stdout;
+* ``span``           — one causal-tracing interval (telemetry/tracing.py,
+  schema v10): ``name`` (queue / assemble / dispatch / sync / request
+  for serving, train_dispatch / eval_chunk / epoch_summary /
+  eval_sync / checkpoint for training, sample / stack / queue_put /
+  consumer_wait for the data producer), ``cat`` (the emitting layer),
+  the run-scoped ``trace_id``, ``span_id`` / optional ``parent_id``
+  (the Dapper-style tree), ``start_ms`` / ``dur_ms`` (perf_counter
+  milliseconds — one process-wide monotonic origin, so cross-thread
+  ordering is real), ``tid`` (thread name) and a small ``attrs``
+  payload (program / bucket / shots / request_id / iter). ``cli
+  trace`` assembles these into a Chrome/Perfetto timeline and the
+  critical-path summary.
 
 Version history / migration notes:
 
@@ -160,6 +172,18 @@ Version history / migration notes:
   unchanged (``tests/fixtures/telemetry_v8_schema.jsonl`` pins a
   v8-era log) and the forward-compat rules carry over (the
   future-schema fixture is re-pinned at v10-unknown).
+* **v10** — adds the ``span`` record kind (the causal-tracing layer:
+  request-/step-scoped intervals with trace/span/parent ids, exported
+  to Chrome/Perfetto by ``cli trace``), and the ``serving`` dispatch
+  record gains the optional latency-decomposition fields ``batch_ms``
+  (host batch assembly), ``dispatch_ms`` (device dispatch enqueue) and
+  ``sync_ms`` (host-blocking result fetch) — with ``queue_ms`` they
+  decompose the end-to-end request latency; the rollup mirrors them as
+  ``batch_ms_mean`` / ``dispatch_ms_p50`` / ``sync_ms_p50``. Pure
+  addition beyond the new kind: every v1..v9 record validates
+  unchanged (``tests/fixtures/telemetry_v9_schema.jsonl`` pins a
+  v9-era log) and the forward-compat rules carry over (the
+  future-schema fixture is re-pinned at v11-unknown).
 """
 
 from __future__ import annotations
@@ -167,7 +191,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -193,6 +217,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "analysis": ("programs", "violations"),
     "elastic": ("event",),
     "serving": ("event",),
+    "span": ("name", "cat", "trace_id", "span_id", "start_ms", "dur_ms"),
 }
 
 

@@ -90,7 +90,7 @@ def make_record(kind: str, **fields: Any) -> Dict[str, Any]:
             counts[str(key)] = counter[0]
     record: Dict[str, Any] = {
         "schema": SCHEMA_VERSION,
-        "ts": time.time(),
+        "ts": time.time(),  # lint-ok: MP007 the record envelope's wall-clock timestamp
         "kind": kind,
         **payload,
     }

@@ -1,0 +1,376 @@
+"""End-to-end causal tracing: the span layer under schema v10.
+
+The telemetry stack up to v9 answers "what happened per epoch / per
+dispatch"; this module answers "where did THIS request / THIS step spend
+its time". A **span** is one named, timed interval on one thread —
+``queue`` / ``assemble`` / ``dispatch`` / ``sync`` for a serving
+request, ``train_dispatch`` / ``eval_chunk`` / ``epoch_summary`` /
+``checkpoint`` for the train loop, ``sample`` / ``stack`` /
+``queue_put`` for the data producer — emitted as a schema-v10 ``span``
+telemetry record and assembled downstream into Dapper-style trees and
+Chrome/Perfetto timelines (``cli trace``).
+
+Design constraints (the same proof standard as ``telemetry_level='off'``
+and the fault seams):
+
+* **off is free and bit-identical** — a disabled tracer allocates no
+  span objects (``start_span`` returns ``None`` after one attribute
+  check, the ``span()`` context manager yields without constructing
+  anything) and emits nothing; tracing never touches a jitted program,
+  so jaxprs are unchanged BY CONSTRUCTION (tested anyway);
+* **no device syncs** — spans record ``time.perf_counter`` only; a span
+  around an asynchronous dispatch measures the ENQUEUE interval, and the
+  separate ``sync`` span measures the host-blocking fetch, which is
+  exactly the decomposition a latency postmortem needs;
+* **monotonic clocks** — span times are perf_counter milliseconds (one
+  process-wide monotonic origin, shared across threads), never
+  ``time.time()`` (lint rule MP007 enforces this repo-wide);
+* **causality across threads** — each thread keeps its own parent
+  stack (``threading.local``), and a span can be parented EXPLICITLY
+  (``parent=``, or ``use_parent()`` around a region) so a request
+  submitted on one thread nests the dispatch work a worker thread did
+  for it.
+
+Record shape (``kind='span'``, schema v10): ``name``, ``cat``,
+``trace_id`` (run-scoped), ``span_id``, ``start_ms`` / ``dur_ms``
+(perf_counter based), optional ``parent_id``, ``tid`` (thread name) and
+``attrs`` (small JSON payload: program / bucket / shots / request_id /
+iter ...).
+
+Pure stdlib — importable without jax or numpy, so the exporters below
+run on a laptop against a scp'd log.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "NULL_TRACER",
+    "new_trace_id",
+    "span_records",
+    "to_chrome_trace",
+    "critical_path_summary",
+    "SERVING_STAGES",
+]
+
+#: the serving decomposition stages, in causal order (queue wait in the
+#: micro-batcher, host batch assembly, device dispatch enqueue, host sync)
+SERVING_STAGES = ("queue", "assemble", "dispatch", "sync")
+
+
+def new_trace_id() -> str:
+    """A fresh run-scoped trace id (16 hex chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One open interval; closed (and emitted) by ``Tracer.end_span``."""
+
+    __slots__ = ("name", "cat", "trace_id", "span_id", "parent_id",
+                 "start_ms", "tid", "attrs")
+
+    def __init__(self, name: str, cat: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], start_ms: float, tid: str,
+                 attrs: Dict[str, Any]):
+        self.name = name
+        self.cat = cat
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ms = start_ms
+        self.tid = tid
+        self.attrs = attrs
+
+
+class Tracer:
+    """Span factory + emitter.
+
+    :param emit: ``emit(**fields)`` receives each closed span's record
+        fields (the builder passes ``telemetry.event('span', ...)``, the
+        serving engine a ``make_record``-over-sink wrapper). ``None``
+        DISABLES the tracer: every entry point is a single attribute
+        check, no span objects are allocated, nothing is emitted.
+    :param trace_id: run-scoped id stamped on every span (defaults to a
+        fresh ``new_trace_id()``).
+    """
+
+    def __init__(self, emit: Optional[Callable[..., None]] = None,
+                 trace_id: Optional[str] = None):
+        self.enabled = emit is not None
+        self.trace_id = trace_id or new_trace_id()
+        self._emit = emit
+        self._ids = itertools.count(1)
+        self._ids_lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- parent bookkeeping (per thread) -----------------------------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    def current(self) -> Optional[Span]:
+        """The innermost open span on THIS thread (or None)."""
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    @contextlib.contextmanager
+    def use_parent(self, parent: Optional[Span]) -> Iterator[None]:
+        """Adopt ``parent`` (a span possibly opened on another thread) as
+        this thread's current parent for the duration — the cross-thread
+        causality hook: a batcher worker wraps the engine dispatch in the
+        submitting request's span so the dispatch tree nests under it."""
+        if not self.enabled or parent is None:
+            yield
+            return
+        stack = self._stack()
+        stack.append(parent)
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def _next_id(self) -> str:
+        with self._ids_lock:
+            return f"s{next(self._ids):06d}"
+
+    def start_span(self, name: str, cat: str = "default",
+                   parent: Optional[Span] = None,
+                   start_ms: Optional[float] = None,
+                   **attrs: Any) -> Optional[Span]:
+        """Open a span; returns ``None`` when the tracer is disabled (the
+        off path allocates nothing). ``parent=None`` nests under this
+        thread's innermost open span, if any. ``start_ms`` (perf_counter
+        milliseconds) backdates the span to a stamp the caller already
+        took — the hot-path pattern: measure with bare perf_counter,
+        emit the span AFTER the timed interval so the record's own
+        serialization never rides the numbers it reports."""
+        if not self.enabled:
+            return None
+        if parent is None:
+            parent = self.current()
+        return Span(
+            name=name,
+            cat=cat,
+            trace_id=self.trace_id,
+            span_id=self._next_id(),
+            parent_id=parent.span_id if parent is not None else None,
+            start_ms=(start_ms if start_ms is not None
+                      else time.perf_counter() * 1e3),
+            tid=threading.current_thread().name,
+            attrs=dict(attrs) if attrs else {},
+        )
+
+    def end_span(self, span: Optional[Span],
+                 end_ms: Optional[float] = None, **attrs: Any) -> None:
+        """Close ``span`` and emit its record; no-op on ``None`` (the
+        handle a disabled tracer handed out). ``end_ms`` (perf_counter
+        milliseconds) closes the span at a stamp the caller already took
+        — the companion to ``start_span(start_ms=...)``."""
+        if span is None or not self.enabled:
+            return
+        if attrs:
+            span.attrs.update(attrs)
+        emit = self._emit
+        if emit is None:  # pragma: no cover - enabled implies emit
+            return
+        if end_ms is None:
+            end_ms = time.perf_counter() * 1e3
+        fields: Dict[str, Any] = {
+            "name": span.name,
+            "cat": span.cat,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "start_ms": round(span.start_ms, 3),
+            "dur_ms": round(end_ms - span.start_ms, 3),
+            "tid": span.tid,
+        }
+        if span.parent_id is not None:
+            fields["parent_id"] = span.parent_id
+        if span.attrs:
+            fields["attrs"] = span.attrs
+        emit(**fields)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "default",
+             parent: Optional[Span] = None, **attrs: Any) -> Iterator[
+                 Optional[Span]]:
+        """Context-manager form; nests via the thread-local parent stack.
+        Yields the open span (None when disabled) so callers can attach
+        late attrs (``span.attrs['bucket'] = b``)."""
+        if not self.enabled:
+            yield None
+            return
+        sp = self.start_span(name, cat=cat, parent=parent, **attrs)
+        stack = self._stack()
+        stack.append(sp)  # type: ignore[arg-type]
+        try:
+            yield sp
+        finally:
+            stack.pop()
+            self.end_span(sp)
+
+
+#: the shared disabled tracer: modules take ``tracer or NULL_TRACER`` so
+#: the hot paths carry one attribute check when tracing is off
+NULL_TRACER = Tracer(emit=None)
+
+
+# -- exporters (jax-free, numpy-free: `cli trace` runs these) ---------------
+
+
+def span_records(records: Iterable[dict]) -> List[dict]:
+    """The ``span`` records of a telemetry record stream, in file order."""
+    return [r for r in records if r.get("kind") == "span"]
+
+
+def _numeric(value: Any) -> Optional[float]:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return float(value)
+
+
+def to_chrome_trace(spans: Iterable[dict]) -> Dict[str, Any]:
+    """Assemble span records into Chrome/Perfetto trace-event JSON.
+
+    One complete (``ph='X'``) event per span — ``ts``/``dur`` in
+    microseconds from the span's perf_counter milliseconds (one
+    process-wide monotonic origin, so cross-thread ordering is real) —
+    plus ``M``-phase thread-name metadata so the timeline shows
+    ``serving-batcher`` / ``MainThread`` / producer threads by name.
+    ``args`` carries span/parent ids and the span attrs, which is what
+    lets Perfetto's flow/selection UI reconstruct the causal tree. Spans
+    missing their required numeric fields are skipped, never fatal — a
+    truncated log from a crashed run must still render."""
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for rec in spans:
+        start_ms = _numeric(rec.get("start_ms"))
+        dur_ms = _numeric(rec.get("dur_ms"))
+        name = rec.get("name")
+        if start_ms is None or dur_ms is None or not isinstance(name, str):
+            continue
+        tid_name = str(rec.get("tid", "main"))
+        tid = tids.setdefault(tid_name, len(tids) + 1)
+        args: Dict[str, Any] = {
+            "trace_id": rec.get("trace_id"),
+            "span_id": rec.get("span_id"),
+        }
+        if rec.get("parent_id") is not None:
+            args["parent_id"] = rec["parent_id"]
+        attrs = rec.get("attrs")
+        if isinstance(attrs, dict):
+            args.update(attrs)
+        events.append({
+            "name": name,
+            "cat": str(rec.get("cat", "default")),
+            "ph": "X",
+            "ts": round(start_ms * 1e3, 1),
+            "dur": max(0.0, round(dur_ms * 1e3, 1)),
+            "pid": 1,
+            "tid": tid,
+            "args": args,
+        })
+    events.sort(key=lambda e: e["ts"])
+    meta: List[Dict[str, Any]] = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": tid,
+            "args": {"name": tid_name},
+        }
+        for tid_name, tid in sorted(tids.items(), key=lambda kv: kv[1])
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def _serving_key(attrs: Dict[str, Any]) -> str:
+    return (
+        f"{attrs.get('program', '?')}"
+        f"/b{attrs.get('bucket', '?')}/s{attrs.get('shots', '?')}"
+    )
+
+
+def critical_path_summary(spans: Iterable[dict]) -> Dict[str, Any]:
+    """Condense a span stream into the critical-path report ``cli trace``
+    prints.
+
+    * ``serving`` — per (program, bucket, shots): mean milliseconds in
+      each decomposition stage (queue wait, host assembly, device
+      dispatch enqueue, sync/readback), their sum (``stages_ms``), the
+      mean end-to-end request latency when request root spans are
+      present, and the dispatch count. The queue+assemble+dispatch+sync
+      ≈ end-to-end identity is this report's acceptance check;
+    * ``by_name`` — every span name's count / total / mean milliseconds,
+      the flat profile (train + data spans live here).
+    """
+    by_name: Dict[str, Dict[str, float]] = {}
+    serving: Dict[str, Dict[str, Any]] = {}
+    for rec in spans:
+        dur = _numeric(rec.get("dur_ms"))
+        name = rec.get("name")
+        if dur is None or not isinstance(name, str):
+            continue
+        agg = by_name.setdefault(name, {"count": 0, "total_ms": 0.0})
+        agg["count"] += 1
+        agg["total_ms"] += dur
+        attrs = rec.get("attrs")
+        if not isinstance(attrs, dict):
+            attrs = {}
+        if rec.get("cat") == "serving" and (
+            name in SERVING_STAGES or name == "request"
+        ):
+            if name in ("queue", "request"):
+                # queue/request spans predate grouping (no bucket yet):
+                # attribute them to the shots bucket only
+                key = f"*/b*/s{attrs.get('shots', '?')}"
+            else:
+                key = _serving_key(attrs)
+            if key not in serving:
+                serving[key] = {
+                    s: {"count": 0, "total_ms": 0.0}
+                    for s in (*SERVING_STAGES, "request")
+                }
+            entry = serving[key]
+            slot = entry[name]
+            slot["count"] += 1
+            slot["total_ms"] += dur
+    for agg in by_name.values():
+        agg["mean_ms"] = round(agg["total_ms"] / agg["count"], 3)
+        agg["total_ms"] = round(agg["total_ms"], 3)
+    out_serving: Dict[str, Any] = {}
+    for key, entry in sorted(serving.items()):
+        row: Dict[str, Any] = {}
+        stages_total = 0.0
+        for stage in SERVING_STAGES:
+            slot = entry[stage]
+            mean = (
+                round(slot["total_ms"] / slot["count"], 3)
+                if slot["count"] else None
+            )
+            row[f"{stage}_ms_mean"] = mean
+            row[f"{stage}_count"] = slot["count"]
+            if mean is not None:
+                stages_total += mean
+        row["stages_ms"] = round(stages_total, 3)
+        req = entry["request"]
+        row["request_ms_mean"] = (
+            round(req["total_ms"] / req["count"], 3) if req["count"] else None
+        )
+        row["requests"] = req["count"]
+        out_serving[key] = row
+    return {"by_name": by_name, "serving": out_serving}

@@ -183,6 +183,52 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
     rollup = next(
         (r for r in reversed(sv) if r.get("event") == "rollup"), None
     )
+    # per-(program, bucket, shots) breakdown (the compiled-program grain):
+    # p50/p95 latency + cache-hit rate per dispatch signature instead of
+    # one aggregate line. Records missing the v9 `program` field (v8-era
+    # logs) group under 'adapt'; non-dispatch and malformed records are
+    # simply skipped — pre-v10 logs must render, never crash.
+    per_bucket: Dict[str, Dict[str, Any]] = {}
+    groups: Dict[str, Dict[str, list]] = {}
+    for r in sv:
+        if r.get("event") != "dispatch":
+            continue
+        key = (
+            f"{r.get('program', 'adapt')}"
+            f"/b{r.get('bucket', '?')}/s{r.get('shots', '?')}"
+        )
+        g = groups.setdefault(
+            key, {"adapt": [], "tenants": [], "hits": []}
+        )
+        adapt_v = r.get("adapt_ms")
+        if isinstance(adapt_v, (int, float)) and not isinstance(
+            adapt_v, bool
+        ) and math.isfinite(adapt_v):
+            g["adapt"].append(float(adapt_v))
+        n_tenants = r.get("tenants")
+        if isinstance(n_tenants, int) and not isinstance(n_tenants, bool):
+            g["tenants"].append(n_tenants)
+        hits = r.get("cache_hits")
+        if isinstance(hits, int) and not isinstance(hits, bool):
+            g["hits"].append(hits)
+    for key, g in sorted(groups.items()):
+        tenants_total = sum(g["tenants"])
+        per_bucket[key] = {
+            "dispatches": len(g["tenants"]) or len(g["adapt"]),
+            "tenants": tenants_total,
+            "adapt_ms_p50": (
+                round(_percentile(g["adapt"], 50), 3) if g["adapt"]
+                else None
+            ),
+            "adapt_ms_p95": (
+                round(_percentile(g["adapt"], 95), 3) if g["adapt"]
+                else None
+            ),
+            "cache_hit_rate": (
+                round(sum(g["hits"]) / tenants_total, 4)
+                if g["hits"] and tenants_total else None
+            ),
+        }
     out: Dict[str, Any] = {
         "dispatches": sum(1 for r in sv if r.get("event") == "dispatch"),
         "tenants": sum(tenants),
@@ -207,6 +253,7 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
             (rollup or {}).get("h2d_bytes_per_dispatch")
         ),
         "cache_hit_rate": (rollup or {}).get("cache_hit_rate"),
+        "per_bucket": per_bucket,
     }
     return out
 
@@ -469,6 +516,21 @@ def cmd_summary(args) -> int:
         if sv.get("retraces"):
             parts.append(f"{sv['retraces']} RETRACE(S)")
         lines.append("  serving: " + ", ".join(parts))
+        # the per-(program, bucket, shots) grain: one line per compiled
+        # dispatch signature — where the aggregate p50 actually comes from
+        for key, row in (sv.get("per_bucket") or {}).items():
+            sub = [
+                f"{row['dispatches']} dispatch(es)",
+                f"{row['tenants']} tenant(s)",
+            ]
+            if row.get("adapt_ms_p50") is not None:
+                part = f"p50 {row['adapt_ms_p50']:.2f}ms"
+                if row.get("adapt_ms_p95") is not None:
+                    part += f" p95 {row['adapt_ms_p95']:.2f}ms"
+                sub.append(part)
+            if row.get("cache_hit_rate") is not None:
+                sub.append(f"cache hit {row['cache_hit_rate']:.0%}")
+            lines.append(f"    serving[{key}]: " + ", ".join(sub))
     audit = payload["audit"]
     if audit:
         line = (

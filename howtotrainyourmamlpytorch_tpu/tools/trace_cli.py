@@ -1,0 +1,173 @@
+"""``cli trace`` — render a run's span records as a loadable timeline.
+
+Reads the telemetry JSONL (schema v10 ``span`` records from
+``telemetry/tracing.py``), writes a Chrome/Perfetto trace-event JSON
+(load it at ``ui.perfetto.dev`` or ``chrome://tracing``) and prints the
+critical-path summary:
+
+* the serving latency decomposition per (program, bucket, shots) —
+  mean milliseconds in queue wait vs. batch assembly vs. device
+  dispatch vs. sync/readback, against the mean end-to-end request
+  latency (queue+assemble+dispatch+sync ≈ e2e is the decomposition's
+  acceptance identity);
+* the flat per-span-name profile (train dispatch / eval chunk / epoch
+  summary / checkpoint, data producer sample/stack/queue_put and
+  consumer_wait);
+* any on-demand device-profile windows (``trace`` records) captured
+  during the run, linked by trace id to the host spans.
+
+.. code-block:: console
+
+   python -m howtotrainyourmamlpytorch_tpu.cli trace LOG
+   python -m howtotrainyourmamlpytorch_tpu.cli trace LOG --out run.trace.json
+   python -m howtotrainyourmamlpytorch_tpu.cli trace LOG --json
+
+Pure stdlib + ``telemetry`` (no jax, no numpy) — dispatched jax-free by
+``cli.py`` like ``inspect``, so a scp'd log renders on a laptop. Exit 0
+even on a span-free log (the artifact is then an empty-but-loadable
+trace); exit 2 on a missing/unparseable log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.schema import iter_records
+from ..telemetry.tracing import (
+    SERVING_STAGES,
+    critical_path_summary,
+    span_records,
+    to_chrome_trace,
+)
+
+
+def _profile_windows(records: List[dict]) -> List[Dict[str, Any]]:
+    """The run's device-profile windows (``trace`` records): start/stop
+    pairs with their trace dirs — the on-demand captures an operator
+    triggered, linked to the host spans by ``trace_id``."""
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        if rec.get("kind") != "trace":
+            continue
+        out.append({
+            k: rec.get(k)
+            for k in ("action", "trace_dir", "steps", "trace_id",
+                      "on_demand")
+            if rec.get(k) is not None
+        })
+    return out
+
+
+def default_out_path(log: str) -> str:
+    base = log[:-6] if log.endswith(".jsonl") else log
+    return base + ".trace.json"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace",
+        description="Render span telemetry as a Chrome/Perfetto trace + "
+                    "critical-path summary (jax-free)",
+    )
+    parser.add_argument("log", help="telemetry JSONL (logs/telemetry.jsonl)")
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="Chrome trace-event JSON output path "
+                             "(default: <log>.trace.json); '-' skips the "
+                             "artifact")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable summary on stdout")
+    args = parser.parse_args(argv)
+
+    try:
+        records = list(iter_records(args.log))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    spans = span_records(records)
+    summary = critical_path_summary(spans)
+    windows = _profile_windows(records)
+    trace = to_chrome_trace(spans)
+
+    out_path = None
+    if args.out != "-":
+        out_path = args.out or default_out_path(args.log)
+        tmp = out_path + ".tmp"
+        os.makedirs(
+            os.path.dirname(os.path.abspath(out_path)), exist_ok=True
+        )
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, out_path)
+
+    payload: Dict[str, Any] = {
+        "log": args.log,
+        "spans": len(spans),
+        "trace_events": len(trace["traceEvents"]),
+        "out": out_path,
+        "serving": summary["serving"],
+        "by_name": summary["by_name"],
+        "profile_windows": windows,
+    }
+    if args.json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+
+    lines = [f"{args.log}: {len(spans)} span(s)"]
+    if out_path:
+        lines.append(
+            f"  chrome trace: {out_path} "
+            f"({len(trace['traceEvents'])} events — load at "
+            "ui.perfetto.dev or chrome://tracing)"
+        )
+    if not spans:
+        lines.append(
+            "  no span records: enable tracing_level='on' (train) or "
+            "serve-bench --trace (serving)"
+        )
+    if summary["serving"]:
+        lines.append("  serving critical path (mean ms per dispatch):")
+        for key, row in summary["serving"].items():
+            parts = []
+            for stage in SERVING_STAGES:
+                mean = row.get(f"{stage}_ms_mean")
+                if mean is not None:
+                    parts.append(f"{stage} {mean:.2f}")
+            line = f"    {key}: " + ", ".join(parts or ["no stage spans"])
+            line += f"  (stages {row['stages_ms']:.2f}"
+            if row.get("request_ms_mean") is not None:
+                line += f" vs e2e {row['request_ms_mean']:.2f}"
+            line += ")"
+            lines.append(line)
+    train_names = [
+        n for n in ("train_dispatch", "eval_chunk", "epoch_summary",
+                    "eval_sync", "checkpoint", "sample", "stack",
+                    "queue_put", "consumer_wait")
+        if n in summary["by_name"]
+    ]
+    if train_names:
+        lines.append("  spans by name (count / mean ms / total ms):")
+        for name in train_names:
+            agg = summary["by_name"][name]
+            lines.append(
+                f"    {name}: {agg['count']} / {agg['mean_ms']:.2f} / "
+                f"{agg['total_ms']:.1f}"
+            )
+    if windows:
+        lines.append(f"  device-profile windows: {len(windows)} event(s)")
+        for win in windows:
+            lines.append(
+                f"    {win.get('action')}: {win.get('trace_dir')}"
+                + (f" ({win.get('steps')} steps)" if win.get("steps")
+                   else "")
+            )
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

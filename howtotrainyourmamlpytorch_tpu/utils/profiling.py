@@ -9,6 +9,12 @@ anywhere (SURVEY.md §5). Here:
 * ``TraceWindow`` — scheduled trace capture: profile train iterations
   [M, M+N) of a chosen epoch without code edits (config
   ``profile_epoch`` / ``profile_start_step`` / ``profile_num_steps``);
+* ``OnDemandProfiler`` — RUNTIME-triggered capture: touching
+  ``logs/PROFILE_REQUEST`` (optionally containing a step count) or
+  sending SIGUSR2 arms a ``jax.profiler`` trace over the NEXT N train
+  steps or serving dispatches — no restart, no config change — and
+  reports start/stop (with the run's causal-tracing ``trace_id``) to
+  telemetry so the device profile links back to the host span timeline;
 * ``StepTimer`` — cheap host-side wall-clock stats per training iteration,
   surfaced as ``train_iters_per_sec`` / ``train_step_time_ms`` epoch metrics.
 """
@@ -16,9 +22,13 @@ anywhere (SURVEY.md §5). Here:
 from __future__ import annotations
 
 import contextlib
+import os
 import random
+import signal as _signal
+import sys
+import threading
 import time
-from typing import Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 
 @contextlib.contextmanager
@@ -115,6 +125,208 @@ class TraceWindow:
         the trace only materialises at stop."""
         if self.active:
             self._stop(sync)
+
+
+#: the trigger filename an operator touches under the run's logs dir
+PROFILE_REQUEST_FILENAME = "PROFILE_REQUEST"
+
+
+class OnDemandProfiler:
+    """Runtime-triggered ``jax.profiler`` windows over dispatches.
+
+    The scheduled ``TraceWindow`` needs the window chosen BEFORE the run;
+    this is the live-incident counterpart: while a run (or a serving
+    process) is misbehaving NOW, the operator either
+
+    * writes the trigger file — ``echo 8 > logs/PROFILE_REQUEST``
+      (contents: the dispatch count; empty = ``default_steps``) — or
+    * sends ``SIGUSR2`` (when ``install_signal_handler()`` was called,
+      main-thread processes only),
+
+    and the NEXT ``step()`` call starts a profiler trace capturing that
+    many dispatches into ``out_root/ondemand_<k>/``, stopping (after an
+    optional ``sync`` drain, so the trace actually contains the
+    dispatches) without any restart or config change. ``on_event`` gets
+    ``('start'|'stop', trace_dir=..., steps=..., trace_id=...)`` —
+    wired to the telemetry ``trace`` record, the ``trace_id`` (the run's
+    causal-tracing id) is what links the device profile to the host span
+    timeline in ``cli trace``.
+
+    ``step()`` is called once per dispatch from the hot loop: the idle
+    cost is one ``os.path.exists`` stat (~µs against ms-scale
+    dispatches) plus a flag check. ``profiler_module`` is injectable for
+    tests; default resolves ``jax.profiler`` lazily at first trigger.
+    """
+
+    def __init__(
+        self,
+        request_path: str,
+        out_root: str,
+        default_steps: int = 5,
+        on_event: Optional[Callable[..., None]] = None,
+        trace_id: Optional[str] = None,
+        profiler_module: Any = None,
+    ):
+        self.request_path = request_path
+        self.out_root = out_root
+        self.default_steps = max(1, int(default_steps))
+        self.on_event = on_event
+        self.trace_id = trace_id
+        self._profiler = profiler_module
+        self.active = False
+        self.captures = 0
+        self.trace_dir: Optional[str] = None
+        self._remaining = 0
+        self._signal_pending = False
+        self._disabled_reason: Optional[str] = None
+        self._installed_signum: Optional[int] = None
+        self._previous_handler: Any = None
+
+    # -- triggers ----------------------------------------------------------
+
+    def install_signal_handler(self, signum: int = _signal.SIGUSR2) -> bool:
+        """SIGUSR2 arms a ``default_steps`` window; main thread only
+        (``signal.signal``'s constraint). Returns False (and changes
+        nothing) off the main thread. The handler only sets a flag — all
+        profiler work happens at the next ``step()``, never in signal
+        context."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _on_signal(signum_, frame):
+            self._signal_pending = True
+
+        self._previous_handler = _signal.signal(signum, _on_signal)
+        self._installed_signum = signum
+        return True
+
+    def uninstall_signal_handler(self) -> None:
+        """Restore the handler ``install_signal_handler`` displaced, so a
+        finished run (or a test harness driving builders back to back)
+        never leaks a handler that keeps this profiler alive. No-op when
+        never installed or off the main thread."""
+        if self._installed_signum is None:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        # signal.signal returned None when the prior handler was not
+        # installed from Python — the process default is the only safe
+        # restoration target there
+        previous = self._previous_handler
+        if previous is None:
+            previous = _signal.SIG_DFL
+        _signal.signal(self._installed_signum, previous)
+        self._installed_signum = None
+        self._previous_handler = None
+
+    def trigger(self, num_steps: Optional[int] = None) -> None:
+        """Programmatic arm (what the signal handler and tests use)."""
+        self._signal_pending = True
+        if num_steps is not None:
+            self.default_steps = max(1, int(num_steps))
+
+    def _poll_request(self) -> Optional[int]:
+        """Consume the trigger file; returns the requested step count or
+        None. A file that cannot be removed disables the file trigger
+        (it would re-arm every step forever) with one stderr note — ONLY
+        the file trigger: the signal/programmatic arm checks first, so
+        SIGUSR2 keeps working on a broken logs dir."""
+        if self._signal_pending:
+            self._signal_pending = False
+            return self.default_steps
+        if self._disabled_reason is not None:
+            return None
+        if not os.path.exists(self.request_path):
+            return None
+        steps = self.default_steps
+        try:
+            with open(self.request_path) as f:
+                content = f.read().strip()
+            if content:
+                steps = max(1, int(content))
+        except (OSError, ValueError):
+            pass  # unreadable/garbled request: capture the default window
+        try:
+            os.remove(self.request_path)
+        except OSError as e:
+            self._disabled_reason = repr(e)
+            print(
+                f"[profiling] cannot consume {self.request_path} ({e!r}); "
+                "on-demand file trigger disabled for this run",
+                file=sys.stderr,
+                flush=True,
+            )
+            return None
+        return steps
+
+    # -- the per-dispatch hook ---------------------------------------------
+
+    def step(self, sync: Optional[Callable[[], None]] = None) -> None:
+        """Call once per dispatch, BEFORE enqueueing it. Starts an armed
+        window, counts dispatches while one is open, and stops it (after
+        ``sync``, so asynchronous dispatches land in the trace) once the
+        requested count has been captured."""
+        if self.active:
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self._stop(sync)
+            return
+        steps = self._poll_request()
+        if steps is not None:
+            self._start(steps)
+
+    def close(self, sync: Optional[Callable[[], None]] = None) -> None:
+        """Stop a still-open window (run ended mid-capture) — the trace
+        only materialises at stop."""
+        if self.active:
+            self._stop(sync)
+
+    # -- internals ---------------------------------------------------------
+
+    def _profiler_mod(self):
+        if self._profiler is None:
+            import jax
+
+            self._profiler = jax.profiler
+        return self._profiler
+
+    def _start(self, steps: int) -> None:
+        self.trace_dir = os.path.join(
+            self.out_root, f"ondemand_{self.captures:02d}"
+        )
+        try:
+            self._profiler_mod().start_trace(self.trace_dir)
+        except Exception as e:  # noqa: BLE001 - a diagnostic trigger must
+            # never crash the run it was asked to inspect
+            print(f"[profiling] on-demand trace start failed: {e!r}",
+                  file=sys.stderr, flush=True)
+            self.trace_dir = None
+            return
+        self.active = True
+        self.captures += 1
+        self._remaining = steps
+        if self.on_event is not None:
+            self.on_event(
+                "start", trace_dir=self.trace_dir, steps=steps,
+                trace_id=self.trace_id, on_demand=True,
+            )
+
+    def _stop(self, sync: Optional[Callable[[], None]]) -> None:
+        if sync is not None:
+            # dispatches are asynchronous — drain the device before
+            # stopping so the trace actually contains the profiled steps
+            sync()
+        try:
+            self._profiler_mod().stop_trace()
+        except Exception as e:  # noqa: BLE001 - see _start
+            print(f"[profiling] on-demand trace stop failed: {e!r}",
+                  file=sys.stderr, flush=True)
+        self.active = False
+        if self.on_event is not None:
+            self.on_event(
+                "stop", trace_dir=self.trace_dir, trace_id=self.trace_id,
+                on_demand=True,
+            )
 
 
 class StepTimer:

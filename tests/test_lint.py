@@ -354,5 +354,69 @@ def test_cli_lint_json_output(tmp_path):
 def test_rule_catalogue_lists_all_rules(capsys):
     assert lint.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("MP001", "MP002", "MP003", "MP004", "MP005"):
+    for rule in ("MP001", "MP002", "MP003", "MP004", "MP005", "MP006",
+                 "MP007"):
         assert rule in out
+
+
+# -- MP007: time.time() vs perf_counter -------------------------------------
+
+
+def test_mp007_flags_time_time_module_call(tmp_path):
+    path = tmp_path / "timing.py"
+    path.write_text(
+        "import time\n"
+        "def measure():\n"
+        "    start = time.time()\n"
+        "    work()\n"
+        "    return time.time() - start\n"
+    )
+    violations = lint.lint_file(str(path))
+    assert [v.rule for v in violations] == ["MP007", "MP007"]
+    assert violations[0].line == 3
+
+
+def test_mp007_flags_from_import_and_aliases(tmp_path):
+    path = tmp_path / "aliased.py"
+    path.write_text(
+        "from time import time\n"
+        "import time as clock\n"
+        "a = time()\n"
+        "b = clock.time()\n"
+    )
+    violations = lint.lint_file(str(path))
+    assert [v.rule for v in violations] == ["MP007", "MP007"]
+
+
+def test_mp007_accepts_perf_counter_and_unrelated_time_attrs(tmp_path):
+    path = tmp_path / "clean.py"
+    path.write_text(
+        "import time\n"
+        "def measure():\n"
+        "    start = time.perf_counter()\n"
+        "    time.sleep(0.1)\n"
+        "    m = time.monotonic()\n"
+        "    return time.perf_counter() - start + m\n"
+    )
+    assert lint.lint_file(str(path)) == []
+
+
+def test_mp007_not_armed_without_time_import(tmp_path):
+    path = tmp_path / "other.py"
+    path.write_text(
+        "class time:\n"
+        "    @staticmethod\n"
+        "    def time():\n"
+        "        return 0\n"
+        "x = 1\n"
+    )
+    assert lint.lint_file(str(path)) == []
+
+
+def test_mp007_reasoned_suppression_for_wall_clock_timestamp(tmp_path):
+    path = tmp_path / "stamped.py"
+    path.write_text(
+        "import time\n"
+        "ts = time.time()  # lint-ok: MP007 wall-clock timestamp\n"
+    )
+    assert lint.lint_file(str(path)) == []

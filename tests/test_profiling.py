@@ -174,3 +174,115 @@ def test_initialize_distributed_noop_without_coordinator(monkeypatch):
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
     assert distributed.initialize_distributed() is False
+
+
+# -- OnDemandProfiler: runtime-triggered capture ----------------------------
+
+
+def _ondemand(tmp_path, fake, **kwargs):
+    from howtotrainyourmamlpytorch_tpu.utils.profiling import (
+        OnDemandProfiler,
+    )
+
+    return OnDemandProfiler(
+        str(tmp_path / "PROFILE_REQUEST"),
+        str(tmp_path / "profile_traces"),
+        profiler_module=fake,
+        **kwargs,
+    )
+
+
+def test_ondemand_idle_never_touches_profiler(tmp_path):
+    fake = _FakeProfiler()
+    prof = _ondemand(tmp_path, fake)
+    for _ in range(20):
+        prof.step()
+    prof.close()
+    assert fake.calls == [] and not prof.active
+
+
+def test_ondemand_file_trigger_captures_requested_window(tmp_path):
+    """`echo 3 > PROFILE_REQUEST` mid-run: the NEXT 3 dispatches are
+    captured, the trigger file is consumed, events carry the trace id."""
+    events = []
+    fake = _FakeProfiler()
+    prof = _ondemand(
+        tmp_path, fake,
+        on_event=lambda action, **f: events.append((action, f)),
+        trace_id="ab12cd34ef567890",
+    )
+    prof.step()  # idle
+    (tmp_path / "PROFILE_REQUEST").write_text("3\n")
+    prof.step()  # consumes the trigger, starts the window
+    assert prof.active and fake.calls[0][0] == "start"
+    assert not (tmp_path / "PROFILE_REQUEST").exists()
+    prof.step()  # dispatch 2 of 3
+    prof.step()  # dispatch 3 of 3
+    assert prof.active
+    synced = []
+    prof.step(sync=lambda: synced.append(True))  # window over: stop
+    assert not prof.active
+    assert synced == [True]  # drained before stop
+    assert [c[0] for c in fake.calls] == ["start", "stop"]
+    assert "ondemand_00" in fake.calls[0][1]
+    (start, f0), (stop, f1) = events
+    assert start == "start" and f0["steps"] == 3
+    assert f0["trace_id"] == "ab12cd34ef567890" and f0["on_demand"] is True
+    assert stop == "stop" and f1["trace_dir"] == f0["trace_dir"]
+
+
+def test_ondemand_empty_trigger_uses_default_and_renumbers(tmp_path):
+    fake = _FakeProfiler()
+    prof = _ondemand(tmp_path, fake, default_steps=2)
+    (tmp_path / "PROFILE_REQUEST").write_text("")
+    prof.step()
+    prof.step()
+    prof.step()  # 2-step window over
+    assert not prof.active
+    (tmp_path / "PROFILE_REQUEST").write_text("garbled")
+    prof.step()  # unreadable count: default window, second capture dir
+    assert prof.active
+    prof.close()
+    dirs = [c[1] for c in fake.calls if c[0] == "start"]
+    assert dirs[0].endswith("ondemand_00") and dirs[1].endswith(
+        "ondemand_01"
+    )
+
+
+def test_ondemand_programmatic_trigger_and_signal_flag(tmp_path):
+    """The SIGUSR2 path sets a flag only; the capture starts at the next
+    step() (trigger() is the handler's body)."""
+    fake = _FakeProfiler()
+    prof = _ondemand(tmp_path, fake, default_steps=1)
+    prof.trigger(num_steps=1)
+    assert fake.calls == []  # nothing in signal context
+    prof.step()
+    assert prof.active
+    prof.step()
+    assert not prof.active
+    assert [c[0] for c in fake.calls] == ["start", "stop"]
+
+
+def test_ondemand_close_stops_open_window(tmp_path):
+    fake = _FakeProfiler()
+    prof = _ondemand(tmp_path, fake, default_steps=100)
+    prof.trigger()
+    prof.step()
+    assert prof.active
+    prof.close()
+    assert not prof.active
+    assert [c[0] for c in fake.calls] == ["start", "stop"]
+
+
+def test_ondemand_signal_handler_installs_on_main_thread_only(tmp_path):
+    import threading
+
+    fake = _FakeProfiler()
+    prof = _ondemand(tmp_path, fake)
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(prof.install_signal_handler())
+    )
+    t.start()
+    t.join()
+    assert results == [False]  # worker thread: refused, nothing changed

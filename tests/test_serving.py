@@ -516,7 +516,7 @@ def test_serving_telemetry_records_validate(cfg, engine):
     assert records, "engine traffic should have emitted records"
     for rec in records:
         tel.validate_record(rec)
-        assert rec["kind"] == "serving" and rec["schema"] == 9
+        assert rec["kind"] == "serving" and rec["schema"] == tel.SCHEMA_VERSION
     rollup = engine.rollup()
     assert rollup["adapt_ms_p50"] > 0
     assert rollup["adapt_ms_p95"] >= rollup["adapt_ms_p50"]
@@ -1179,7 +1179,8 @@ def test_serve_bench_fast_end_to_end(tmp_path, capsys):
 
     log = tmp_path / "serving.jsonl"
     rc = serve_bench.main(
-        ["--fast", "--requests", "7", "--telemetry", str(log)]
+        ["--fast", "--requests", "7", "--telemetry", str(log),
+         "--trace", "--metrics-port", "0"]
     )
     out = capsys.readouterr().out
     assert rc == 0
@@ -1190,7 +1191,317 @@ def test_serve_bench_fast_end_to_end(tmp_path, capsys):
     assert rec["tenants_per_sec"] > 0
     assert rec["tenants"] == 7
     assert rec["retraces"] == 0
-    # per-dispatch records + the warmup record + the rollup
-    assert tel.validate_file(str(log)) == rec["dispatches"] + 2
+    # the v10 latency decomposition rides the line: dispatch + sync == the
+    # end-to-end adapt latency (same clock, same dispatches)
+    assert rec["dispatch_ms_p50"] > 0 and rec["sync_ms_p50"] >= 0
+    assert rec["batch_ms_mean"] >= 0 and rec["queue_ms_p50"] == 0.0
+    assert rec["metrics_port"] > 0 and rec["traced"] is True
+    # the log validates: per-dispatch records + warmup + rollup + spans
+    recs = list(tel.iter_records(str(log)))
+    tel.validate_file(str(log))
+    spans = [r for r in recs if r["kind"] == "span"]
+    assert spans and {"assemble", "dispatch", "sync"} <= {
+        s["name"] for s in spans
+    }
     assert telemetry_cli.main(["summary", str(log)]) == 0
-    assert "serving:" in capsys.readouterr().out
+    summary_out = capsys.readouterr().out
+    assert "serving:" in summary_out
+    assert "serving[adapt/b" in summary_out  # the per-bucket breakdown
+    # `cli trace` renders the spans into a loadable Chrome trace
+    from howtotrainyourmamlpytorch_tpu.tools import trace_cli
+
+    assert trace_cli.main([str(log)]) == 0
+    trace = json.loads((tmp_path / "serving.trace.json").read_text())
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+# -- schema v10: latency decomposition, spans, watchdog, metrics -------------
+
+
+def test_dispatch_latency_decomposition_adds_up(cfg, engine):
+    """The acceptance identity: queue + batch + dispatch + sync accounts
+    for the end-to-end latency. adapt_ms == dispatch_ms + sync_ms by
+    construction (the same perf_counter stamps), and the summed stages
+    cover the serve_group wall time up to the cache-lookup/realign
+    slack."""
+    import time as _time
+
+    rng = np.random.RandomState(5)
+    reqs = [_request(cfg, rng) for _ in range(2)]
+    t0 = _time.perf_counter()
+    dr = engine.serve_group(reqs)
+    wall_ms = (_time.perf_counter() - t0) * 1e3
+    assert dr.adapt_ms == pytest.approx(
+        dr.dispatch_ms + dr.sync_ms, rel=0.01, abs=0.05
+    )
+    parts = dr.queue_ms + dr.batch_ms + dr.dispatch_ms + dr.sync_ms
+    # stages never exceed the wall and cover most of it (realign +
+    # result-object assembly is the only unattributed work)
+    assert parts <= wall_ms + 0.5
+    assert parts >= 0.4 * wall_ms
+    # the dispatch record carries the same decomposition, schema-valid
+    rec = engine.sink.records[-1]
+    assert rec["kind"] == "serving" and rec["event"] == "dispatch"
+    tel.validate_record(rec)
+    assert rec["adapt_ms"] == pytest.approx(
+        rec["dispatch_ms"] + rec["sync_ms"], rel=0.02, abs=0.1
+    )
+    assert rec["batch_ms"] >= 0
+    # and the rollup mirrors it
+    rollup = engine.rollup()
+    assert rollup["dispatch_ms_p50"] > 0
+    assert rollup["sync_ms_p50"] >= 0
+    assert rollup["batch_ms_mean"] >= 0
+
+
+def test_tracing_off_emits_no_spans_same_dispatch_count(cfg, engine):
+    """Tracing off is free: no span records, identical dispatch count
+    (the compiled programs never see the tracer), zero retraces."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import make_record
+    from howtotrainyourmamlpytorch_tpu.telemetry.tracing import Tracer
+
+    rng = np.random.RandomState(11)
+    groups = [[_request(cfg, rng)], [_request(cfg, rng) for _ in range(2)]]
+
+    def dispatch_count():
+        return sum(
+            1 for r in engine.sink.records
+            if r.get("kind") == "serving" and r.get("event") == "dispatch"
+        )
+
+    base = dispatch_count()
+    retraces0 = engine.retrace_detector.retrace_count
+    for g in groups:
+        engine.serve_group(g)
+    off_dispatches = dispatch_count() - base
+    spans = []
+    engine.tracer = Tracer(
+        emit=lambda **f: spans.append(make_record("span", **f))
+    )
+    try:
+        for g in groups:
+            engine.serve_group(g)
+    finally:
+        from howtotrainyourmamlpytorch_tpu.telemetry.tracing import (
+            NULL_TRACER,
+        )
+
+        engine.tracer = NULL_TRACER
+    on_dispatches = dispatch_count() - base - off_dispatches
+    assert off_dispatches == on_dispatches == len(groups)
+    assert engine.retrace_detector.retrace_count == retraces0
+    # tracing ON emitted stage spans; the engine's JSONL sink saw NONE
+    # of the off half's dispatches produce span records
+    assert spans and {"assemble", "dispatch", "sync"} <= {
+        s["name"] for s in spans
+    }
+    assert not any(r.get("kind") == "span" for r in engine.sink.records)
+
+
+def test_microbatcher_spans_nest_request_to_sync(cfg, engine):
+    """One submitted request's span tree crosses threads: queue ends
+    before the dispatch, and the engine's assemble/dispatch/sync spans
+    (worker thread) nest under the request root (submit thread)."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import make_record
+    from howtotrainyourmamlpytorch_tpu.telemetry.tracing import (
+        NULL_TRACER,
+        Tracer,
+    )
+
+    spans = []
+    tracer = Tracer(
+        emit=lambda **f: spans.append(make_record("span", **f))
+    )
+    engine.tracer = tracer
+    try:
+        batcher = MicroBatcher(engine, max_wait_ms=0.0)
+        rng = np.random.RandomState(13)
+        handle = batcher.submit(_request(cfg, rng, tenant_id="t-span"))
+        result = handle.get(timeout=60)
+        batcher.close()
+    finally:
+        engine.tracer = NULL_TRACER
+    assert result.preds is not None
+    for rec in spans:
+        tel.validate_record(rec)
+    by_name = {}
+    for rec in spans:
+        by_name.setdefault(rec["name"], []).append(rec)
+    for name in ("request", "queue", "assemble", "dispatch", "sync"):
+        assert name in by_name, f"missing {name!r} span"
+    request = by_name["request"][0]
+    assert request["attrs"]["request_id"].startswith(tracer.trace_id)
+    assert request["attrs"]["tenant_id"] == "t-span"
+    assert request["attrs"]["outcome"] == "served"
+    # the causal tree: queue AND the engine stages all parent on the root
+    root_id = request["span_id"]
+    for name in ("queue", "dispatch", "sync"):
+        assert by_name[name][0]["parent_id"] == root_id, name
+    # queue closed before the dispatch opened (grouping happened between)
+    q = by_name["queue"][0]
+    d = by_name["dispatch"][0]
+    assert q["start_ms"] + q["dur_ms"] <= d["start_ms"] + 0.5
+    # worker-thread spans carry the worker's thread name
+    assert d["tid"] == "serving-batcher"
+    assert request["tid"] != "serving-batcher"
+
+
+def test_engine_beats_watchdog_per_dispatch(cfg, engine):
+    beats = []
+
+    class _Dog:
+        def beat(self, stage):
+            beats.append(stage)
+
+    engine.watchdog = _Dog()
+    try:
+        rng = np.random.RandomState(3)
+        engine.serve_group([_request(cfg, rng)])
+    finally:
+        engine.watchdog = None
+    assert beats == ["serve_step[i=f32,b=1,s=1]"]
+
+
+def test_serving_watchdog_stall_record_and_incident(cfg, engine, tmp_path):
+    """A wedged serving dispatch (simulated: beats stop) produces one
+    schema-valid watchdog_stall record naming the dispatch site plus a
+    flight-recorder incident directory."""
+    import os as _os
+    import time as _time
+
+    from howtotrainyourmamlpytorch_tpu.serving.engine import (
+        attach_serving_watchdog,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry import FlightRecorder
+
+    sink = _ListSink()
+    recorder = FlightRecorder(8, str(tmp_path / "incidents"))
+    recorder.note_event("dispatch", site="serve_step[i=f32,b=1,s=1]")
+    dog = attach_serving_watchdog(
+        engine, timeout_s=0.15, sink=sink, recorder=recorder
+    )
+    try:
+        assert engine.watchdog is dog
+        dog.beat("serve_step[i=f32,b=2,s=1]")  # the wedged dispatch
+        deadline = _time.perf_counter() + 5.0
+        while not sink.records and _time.perf_counter() < deadline:
+            _time.sleep(0.05)
+    finally:
+        dog.stop()
+        engine.watchdog = None
+    stalls = [r for r in sink.records if r["kind"] == "watchdog_stall"]
+    assert len(stalls) == 1  # one loud diagnostic, not a flood
+    tel.validate_record(stalls[0])
+    assert stalls[0]["stage"] == "serve_step[i=f32,b=2,s=1]"
+    assert stalls[0]["stacks"]
+    assert stalls[0]["recorder_tail"]  # the ring context rode along
+    incidents = [r for r in sink.records if r["kind"] == "incident"]
+    assert incidents and _os.path.isdir(incidents[0]["path"])
+    assert incidents[0]["reason"] == "watchdog_stall"
+
+
+def test_serving_metrics_endpoint_consistent_with_records(cfg, engine):
+    """ServingMetrics teed off the live record stream: counters and
+    histogram totals match the records, and the endpoint serves
+    parseable Prometheus text while the engine dispatches."""
+    import urllib.request
+
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+        FanoutSink,
+        MetricsServer,
+        ServingMetrics,
+        parse_prometheus_text,
+    )
+
+    capture = _ListSink()
+    metrics = ServingMetrics()
+    old_sink = engine.sink
+    engine.sink = FanoutSink(capture, metrics)
+    server = MetricsServer(metrics, port=0)
+    try:
+        rng = np.random.RandomState(17)
+        engine.serve_group([_request(cfg, rng)])
+        engine.serve_group([_request(cfg, rng) for _ in range(2)])
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+    finally:
+        server.close()
+        engine.sink = old_sink
+    series = parse_prometheus_text(text)  # raises on malformed lines
+    dispatches = [
+        r for r in capture.records
+        if r["kind"] == "serving" and r["event"] == "dispatch"
+    ]
+    assert series["serving_requests_total"][""] == sum(
+        r["tenants"] for r in dispatches
+    ) == 3
+    assert series["serving_dispatches_total"]['program="adapt"'] == 2
+    assert series["serving_h2d_bytes_total"][""] == sum(
+        r["ingest_bytes"] for r in dispatches
+    )
+    assert series["serving_adapt_latency_ms_count"][""] == 2
+    assert series["serving_adapt_latency_ms_sum"][""] == pytest.approx(
+        sum(r["adapt_ms"] for r in dispatches), rel=0.01
+    )
+    # histogram buckets are cumulative and end at the count
+    buckets = series["serving_adapt_latency_ms_bucket"]
+    values = [v for _, v in sorted(buckets.items())]
+    assert buckets['le="+Inf"'] == 2
+    assert all(v <= 2 for v in values)
+    assert series["serving_cache_hits_total"][""] == 0
+
+
+def test_metrics_queue_depth_gauge_via_batcher(cfg, engine):
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+        ServingMetrics,
+    )
+
+    metrics = ServingMetrics()
+    batcher = MicroBatcher(engine, max_wait_ms=0.0, metrics=metrics)
+    rng = np.random.RandomState(19)
+    handle = batcher.submit(_request(cfg, rng))
+    handle.get(timeout=60)
+    batcher.close()
+    # the gauge saw the enqueue (depth 1) and renders in the exposition
+    assert "serving_queue_depth" in metrics.render()
+
+
+def test_engine_polls_ondemand_profiler_per_dispatch(cfg, engine, tmp_path):
+    """The serving half of on-demand profiling: a trigger file captures
+    the next N dispatches (warmup excluded by construction — the engine
+    only polls outside warmup)."""
+
+    class _FakeProfiler:
+        def __init__(self):
+            self.calls = []
+
+        def start_trace(self, d):
+            self.calls.append(("start", d))
+
+        def stop_trace(self):
+            self.calls.append(("stop",))
+
+    from howtotrainyourmamlpytorch_tpu.utils.profiling import (
+        OnDemandProfiler,
+    )
+
+    fake = _FakeProfiler()
+    prof = OnDemandProfiler(
+        str(tmp_path / "PROFILE_REQUEST"), str(tmp_path / "traces"),
+        profiler_module=fake,
+    )
+    engine.profiler = prof
+    try:
+        rng = np.random.RandomState(23)
+        engine.serve_group([_request(cfg, rng)])  # idle: no trigger yet
+        assert fake.calls == []
+        (tmp_path / "PROFILE_REQUEST").write_text("2")
+        engine.serve_group([_request(cfg, rng)])  # starts the window
+        assert prof.active
+        engine.serve_group([_request(cfg, rng)])  # captured dispatch 2
+        engine.serve_group([_request(cfg, rng)])  # window over: stopped
+        assert not prof.active
+    finally:
+        engine.profiler = None
+    assert [c[0] for c in fake.calls] == ["start", "stop"]

@@ -450,6 +450,7 @@ def test_builder_telemetry_e2e_smoke(tmp_path):
         steps_per_dispatch=2,  # fused dispatch: dynamics arrive (k,)-stacked
         eval_batches_per_dispatch=2,
         telemetry_level="dynamics",
+        tracing_level="on",  # schema-v10 spans ride the same log
         watchdog_timeout_s=120.0,  # enabled, but must stay quiet
     )
     model = MAMLFewShotClassifier(cfg, use_mesh=False)
@@ -496,6 +497,22 @@ def test_builder_telemetry_e2e_smoke(tmp_path):
         assert isinstance(rec.get("boundary_overlaps"), int)
         assert isinstance(rec.get("overlap_ms"), (int, float))
     assert sum(r["boundary_overlaps"] for r in disp_recs) > 0
+    # schema-v10 causal tracing: the run emitted span records for every
+    # train dispatch / eval chunk / epoch summary / checkpoint, all under
+    # one run-scoped trace id, with the epoch_summary span present (the
+    # PR 11 boundary overlap as an interval on the timeline)
+    span_recs = [r for r in recs if r["kind"] == "span"]
+    span_names = {r["name"] for r in span_recs}
+    for expected in ("train_dispatch", "eval_chunk", "epoch_summary",
+                     "eval_sync", "checkpoint"):
+        assert expected in span_names, f"missing {expected!r} spans"
+    assert len({r["trace_id"] for r in span_recs}) == 1
+    # 2 epochs x 4 iters at steps_per_dispatch=2 -> 4 train dispatches
+    assert sum(1 for r in span_recs if r["name"] == "train_dispatch") == 4
+    for rec in span_recs:
+        assert rec["dur_ms"] >= 0 and rec["start_ms"] > 0
+    # the data producer emitted its pipeline spans on the same trace
+    assert "sample" in span_names and "stack" in span_names
     # per-epoch records carry the CSV row's scalars + the stream stats
     epoch_recs = [r for r in recs if r["kind"] == "epoch"]
     assert len(epoch_recs) == 2
@@ -521,6 +538,13 @@ def test_config_validates_telemetry_knobs(tiny_cfg):
     with pytest.raises(ValueError, match="profile_start_step"):
         tiny_cfg.replace(profile_start_step=-2)
     assert tiny_cfg.replace(telemetry_level="scalars").telemetry_level == "scalars"
+    with pytest.raises(ValueError, match="tracing_level"):
+        tiny_cfg.replace(tracing_level="bogus")
+    with pytest.raises(ValueError, match="tracing_level='on' requires"):
+        tiny_cfg.replace(tracing_level="on", telemetry_level="off")
+    assert tiny_cfg.replace(
+        telemetry_level="scalars", tracing_level="on"
+    ).tracing_level == "on"
 
 
 # -- schema forward compatibility (v2) --------------------------------------
@@ -768,6 +792,53 @@ def test_v9_serving_fast_path_fields_validate():
         adapt_ms_p50=4.1, adapt_ms_p95=9.9, tenants_per_sec=120.5,
         retraces=0, ingest="index", h2d_bytes_per_dispatch=412.0,
         cache_hit_rate=0.62,
+    ))
+
+
+def test_validate_file_accepts_v9_era_fixture():
+    """The pinned v9-era log (the fast-path serving fields and warmup
+    shape of the PREVIOUS schema) validates unchanged under v10."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v9_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 7
+
+
+def test_v10_span_record_kind_validates():
+    """The schema v10 span kind: make_record round-trips with the full
+    field set (parent/attrs optional), and a span missing its required
+    interval fields is rejected."""
+    rec = tel.make_record(
+        "span", name="dispatch", cat="serving",
+        trace_id="ab12cd34ef567890", span_id="s000042",
+        parent_id="s000041", start_ms=10321.5, dur_ms=4.25,
+        tid="serving-batcher",
+        attrs={"program": "adapt", "bucket": 4, "shots": 1},
+    )
+    assert rec["schema"] == tel.SCHEMA_VERSION and rec["kind"] == "span"
+    tel.validate_record(rec)
+    json.dumps(rec, allow_nan=False)
+    # minimal span (no parent, no attrs) also validates
+    tel.validate_record(tel.make_record(
+        "span", name="train_dispatch", cat="train",
+        trace_id="ab12cd34ef567890", span_id="s000001",
+        start_ms=1.0, dur_ms=0.5,
+    ))
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "span",
+            "name": "dispatch", "cat": "serving",
+        })
+
+
+def test_v10_serving_decomposition_fields_validate():
+    """The v10 serving-dispatch decomposition fields (batch/dispatch/
+    sync) are pure additions: the record validates with and without."""
+    tel.validate_record(tel.make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.5, adapt_ms=4.0, program="adapt", ingest="f32",
+        ingest_bytes=2048, cache_hits=0,
+        batch_ms=0.2, dispatch_ms=3.1, sync_ms=0.9,
     ))
 
 

@@ -366,3 +366,168 @@ def test_summary_without_elastic_records_omits_elastic_line(tmp_path, capsys):
     assert json.loads(capsys.readouterr().out)["elastic"] is None
     assert cli_main(["summary", log]) == 0
     assert "elastic:" not in capsys.readouterr().out
+
+
+def test_summary_per_bucket_serving_breakdown(tmp_path, capsys):
+    """Schema v10: the serving line grows a per-(program, bucket, shots)
+    breakdown — p50/p95 and cache-hit rate per compiled dispatch
+    signature — and stays crash-free on records missing the newer
+    fields (a v8-era log groups under program 'adapt')."""
+    records = _run_records([0.5])
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.5, adapt_ms=4.0, program="adapt", ingest="f32",
+        ingest_bytes=1024, cache_hits=0,
+    ))
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=4, bucket=4, shots=1,
+        queue_ms=0.5, adapt_ms=8.0, program="adapt", ingest="f32",
+        ingest_bytes=2048, cache_hits=0,
+    ))
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.1, adapt_ms=1.0, program="predict", ingest="f32",
+        ingest_bytes=512, cache_hits=2,
+    ))
+    # a v8-era dispatch record: no program/cache fields at all
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=1, bucket=1, shots=2,
+        queue_ms=0.2, adapt_ms=3.0,
+    ))
+    log = _write_log(tmp_path / "sv.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    per_bucket = payload["serving"]["per_bucket"]
+    assert set(per_bucket) == {
+        "adapt/b2/s1", "adapt/b4/s1", "predict/b2/s1", "adapt/b1/s2",
+    }
+    assert per_bucket["adapt/b2/s1"]["adapt_ms_p50"] == 4.0
+    assert per_bucket["predict/b2/s1"]["cache_hit_rate"] == 1.0
+    assert per_bucket["adapt/b1/s2"]["dispatches"] == 1
+    assert per_bucket["adapt/b1/s2"]["cache_hit_rate"] is None
+    assert cli_main(["summary", log]) == 0
+    out = capsys.readouterr().out
+    assert "serving[adapt/b2/s1]:" in out
+    assert "serving[predict/b2/s1]:" in out
+    assert "cache hit 100%" in out
+
+
+def test_summary_pre_v10_serving_log_never_crashes(tmp_path, capsys):
+    """A v8-era serving log (no decomposition, no program field) renders
+    the aggregate line and a degraded per-bucket breakdown — exit 0."""
+    records = _run_records([0.5])
+    records.insert(-1, {
+        "schema": 8, "ts": 1.0, "kind": "serving", "event": "dispatch",
+        "tenants": 3, "bucket": 4, "shots": 1, "queue_ms": 0.9,
+        "adapt_ms": 4.4,
+    })
+    log = _write_log(tmp_path / "v8.jsonl", records)
+    assert cli_main(["summary", log]) == 0
+    assert "serving[adapt/b4/s1]:" in capsys.readouterr().out
+
+
+# -- cli trace ---------------------------------------------------------------
+
+
+def _span(name, cat, trace_id, span_id, start_ms, dur_ms, parent=None,
+          **attrs):
+    fields = dict(
+        name=name, cat=cat, trace_id=trace_id, span_id=span_id,
+        start_ms=start_ms, dur_ms=dur_ms, tid="main",
+    )
+    if parent:
+        fields["parent_id"] = parent
+    if attrs:
+        fields["attrs"] = attrs
+    return make_record("span", **fields)
+
+
+def _span_log_records():
+    tid = "ab12cd34ef567890"
+    return _run_records([0.5])[:-1] + [
+        _span("request", "serving", tid, "s1", 100.0, 10.0,
+              request_id=f"{tid}-r1", shots=1),
+        _span("queue", "serving", tid, "s2", 100.0, 2.0, parent="s1",
+              shots=1),
+        _span("assemble", "serving", tid, "s3", 102.0, 1.0, parent="s1",
+              program="adapt", bucket=2, shots=1),
+        _span("dispatch", "serving", tid, "s4", 103.0, 5.0, parent="s1",
+              program="adapt", bucket=2, shots=1),
+        _span("sync", "serving", tid, "s5", 108.0, 2.0, parent="s1",
+              program="adapt", bucket=2, shots=1),
+        _span("train_dispatch", "train", tid, "s6", 120.0, 30.0, iter=0),
+        make_record("trace", action="start", trace_dir="/tmp/prof0",
+                    steps=4, trace_id=tid, on_demand=True),
+        make_record("trace", action="stop", trace_dir="/tmp/prof0",
+                    trace_id=tid, on_demand=True),
+        make_record("run_end"),
+    ]
+
+
+def test_trace_cli_writes_chrome_trace_and_summary(tmp_path, capsys):
+    from howtotrainyourmamlpytorch_tpu.tools.trace_cli import main as trace_main
+
+    log = _write_log(tmp_path / "run.jsonl", _span_log_records())
+    assert trace_main([log]) == 0
+    out = capsys.readouterr().out
+    assert "6 span(s)" in out
+    assert "adapt/b2/s1" in out
+    assert "train_dispatch" in out
+    assert "device-profile windows" in out
+    artifact = tmp_path / "run.trace.json"
+    trace = json.loads(artifact.read_text())
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 6
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    # the request root's children cover queue -> dispatch -> sync
+    kids = {e["name"] for e in xs if e["args"].get("parent_id") == "s1"}
+    assert {"queue", "assemble", "dispatch", "sync"} <= kids
+    # the decomposition identity: stage means sum to the request e2e
+    # (2 + 1 + 5 + 2 == 10) within the exporter's rounding
+    assert trace_main([log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    row = payload["serving"]["adapt/b2/s1"]
+    stage_sum = sum(
+        row[f"{s}_ms_mean"] or 0.0
+        for s in ("assemble", "dispatch", "sync")
+    ) + payload["serving"]["*/b*/s1"]["queue_ms_mean"]
+    e2e = payload["serving"]["*/b*/s1"]["request_ms_mean"]
+    assert stage_sum == pytest.approx(e2e, rel=0.05)
+
+
+def test_trace_cli_span_free_log_exits_zero(tmp_path, capsys):
+    from howtotrainyourmamlpytorch_tpu.tools.trace_cli import main as trace_main
+
+    log = _write_log(tmp_path / "bare.jsonl", _run_records([0.4]))
+    out_path = tmp_path / "bare.trace.json"
+    assert trace_main([log, "--out", str(out_path)]) == 0
+    assert "no span records" in capsys.readouterr().out
+    trace = json.loads(out_path.read_text())
+    assert trace["traceEvents"] == []
+
+
+def test_trace_cli_missing_log_exits_2(tmp_path, capsys):
+    from howtotrainyourmamlpytorch_tpu.tools.trace_cli import main as trace_main
+
+    assert trace_main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_cli_trace_dispatch_is_jax_free(tmp_path):
+    """`python -m ...cli trace` must answer without importing jax — the
+    same laptop-postmortem contract as inspect."""
+    log = _write_log(tmp_path / "t.jsonl", _span_log_records())
+    code = (
+        "import sys\n"
+        "from howtotrainyourmamlpytorch_tpu.cli import main\n"
+        "try:\n"
+        f"    main(['trace', {log!r}, '--out', '-'])\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        "assert 'jax' not in sys.modules, 'trace pulled in jax'\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]

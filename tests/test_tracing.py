@@ -1,0 +1,258 @@
+"""telemetry/tracing.py — the causal span layer (schema v10).
+
+The load-bearing contracts:
+
+* **off is free**: a disabled tracer allocates no span objects and emits
+  nothing — the off path is one attribute check (the telemetry-off proof
+  standard), and tracing never feeds the jitted programs, so jaxprs are
+  independent of the knob by construction (pinned below anyway);
+* spans carry monotonic perf_counter intervals, nest through the
+  thread-local parent stack, can be parented explicitly ACROSS threads
+  (``use_parent``), and every emitted record is schema-valid;
+* the Chrome/Perfetto exporter produces structurally valid trace-event
+  JSON: monotonic ``ts``, complete (``ph='X'``) events, thread-name
+  metadata, and parent/child containment for a nested request tree;
+* the critical-path summary recovers the serving
+  queue/assemble/dispatch/sync decomposition per (program, bucket,
+  shots).
+
+Pure host-side tests — no jax except the one jaxpr-identity pin.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.telemetry import schema as tel
+from howtotrainyourmamlpytorch_tpu.telemetry import tracing
+from howtotrainyourmamlpytorch_tpu.telemetry.sinks import make_record
+
+
+def make_tracer():
+    records = []
+    tracer = tracing.Tracer(
+        emit=lambda **f: records.append(make_record("span", **f))
+    )
+    return tracer, records
+
+
+# -- the off path ------------------------------------------------------------
+
+
+def test_disabled_tracer_allocates_and_emits_nothing():
+    null = tracing.NULL_TRACER
+    assert not null.enabled
+    assert null.start_span("x", cat="train") is None
+    null.end_span(None)  # the handle it handed out: a no-op
+    with null.span("y", cat="train") as sp:
+        assert sp is None
+    assert null.current() is None
+    with null.use_parent(None):
+        pass
+
+
+def test_jitted_programs_independent_of_tracing_level():
+    """tracing_level never reaches a program factory: the train step's
+    jaxpr is byte-identical with tracing on and off (the
+    telemetry_level='off' bit-identity standard)."""
+    jax = pytest.importorskip("jax")
+    from conftest import make_micro_cfg, make_synthetic_batch
+
+    from howtotrainyourmamlpytorch_tpu.core import maml
+
+    cfg_off = make_micro_cfg()
+    cfg_on = make_micro_cfg(
+        telemetry_level="scalars", tracing_level="on"
+    )
+    batch = make_synthetic_batch(cfg_off)
+    import numpy as np
+
+    weights = np.ones(
+        cfg_off.number_of_training_steps_per_iter, np.float32
+    )
+
+    def jaxpr_for(cfg):
+        state = maml.init_state(cfg)
+        step = maml.make_train_step(cfg, second_order=True)
+        return str(jax.make_jaxpr(step)(state, *batch, weights, 1e-3))
+
+    assert jaxpr_for(cfg_off) == jaxpr_for(cfg_on)
+
+
+# -- span emission -----------------------------------------------------------
+
+
+def test_spans_emit_schema_valid_records_with_nesting():
+    tracer, records = make_tracer()
+    with tracer.span("request", cat="serving", request_id="t-1") as root:
+        assert tracer.current() is root
+        with tracer.span("queue", cat="serving", shots=1):
+            time.sleep(0.001)
+        with tracer.span("dispatch", cat="serving",
+                         program="adapt", bucket=2, shots=1):
+            pass
+    assert tracer.current() is None
+    assert [r["name"] for r in records] == ["queue", "dispatch", "request"]
+    for rec in records:
+        tel.validate_record(rec)
+        assert rec["trace_id"] == tracer.trace_id
+        assert rec["dur_ms"] >= 0
+    queue, dispatch, request = records
+    assert queue["parent_id"] == request["span_id"]
+    assert dispatch["parent_id"] == request["span_id"]
+    assert "parent_id" not in request
+    assert queue["dur_ms"] >= 1.0  # the sleep is inside the interval
+    assert queue["attrs"] == {"shots": 1}
+    assert dispatch["attrs"] == {"program": "adapt", "bucket": 2,
+                                 "shots": 1}
+
+
+def test_explicit_start_end_and_late_attrs():
+    tracer, records = make_tracer()
+    sp = tracer.start_span("checkpoint", cat="train", epoch=3)
+    assert sp is not None and tracer.current() is None  # explicit form
+    tracer.end_span(sp, outcome="saved")
+    (rec,) = records
+    assert rec["attrs"] == {"epoch": 3, "outcome": "saved"}
+
+
+def test_use_parent_carries_causality_across_threads():
+    """The batcher pattern: a request span opened on the submit thread
+    parents dispatch spans emitted by a worker thread."""
+    tracer, records = make_tracer()
+    root = tracer.start_span("request", cat="serving", request_id="r-9")
+
+    def worker():
+        with tracer.use_parent(root):
+            with tracer.span("dispatch", cat="serving", program="adapt",
+                             bucket=1, shots=1):
+                pass
+
+    t = threading.Thread(target=worker, name="test-worker")
+    t.start()
+    t.join()
+    tracer.end_span(root)
+    dispatch, request = records
+    assert dispatch["parent_id"] == request["span_id"]
+    assert dispatch["tid"] == "test-worker"
+    assert request["tid"] != "test-worker"
+
+
+def test_thread_local_stacks_do_not_cross_threads():
+    tracer, records = make_tracer()
+    seen = []
+
+    def worker():
+        seen.append(tracer.current())
+
+    with tracer.span("outer", cat="train"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen == [None]  # the other thread's stack is its own
+
+
+# -- the Chrome/Perfetto exporter -------------------------------------------
+
+
+def _request_tree_records():
+    """One request tree (queue -> dispatch -> sync under a root) plus an
+    unrelated train span, as emitted records."""
+    tracer, records = make_tracer()
+    with tracer.span("request", cat="serving", request_id="r-1",
+                     shots=1) as root:
+        with tracer.span("queue", cat="serving", shots=1):
+            time.sleep(0.001)
+        with tracer.use_parent(root):
+            with tracer.span("dispatch", cat="serving", program="adapt",
+                             bucket=2, shots=1):
+                time.sleep(0.001)
+            with tracer.span("sync", cat="serving", program="adapt",
+                             bucket=2, shots=1):
+                pass
+    with tracer.span("train_dispatch", cat="train", iter=0):
+        pass
+    return records
+
+
+def test_chrome_trace_structure():
+    records = _request_tree_records()
+    trace = tracing.to_chrome_trace(records)
+    json.dumps(trace)  # loadable
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(xs) == len(records)
+    # complete events only (no unmatched B/E), monotonic ts
+    assert all(e["ph"] in ("X", "M") for e in events)
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    assert all(e["dur"] >= 0 for e in xs)
+    # thread-name metadata present for every tid used
+    named = {m["args"]["name"] for m in metas}
+    assert {e["tid"] for e in xs} == {m["tid"] for m in metas}
+    assert named  # at least the main thread
+    # parent/child containment: each child's interval sits inside its
+    # parent's (the request spans queue -> dispatch -> sync)
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    children = [e for e in xs if e["args"].get("parent_id")]
+    assert children, "no nested spans exported"
+    for child in children:
+        parent = by_id[child["args"]["parent_id"]]
+        assert parent["ts"] <= child["ts"]
+        assert (child["ts"] + child["dur"]
+                <= parent["ts"] + parent["dur"] + 100)  # 0.1ms rounding
+    # the request root has queue, dispatch AND sync as children
+    root = next(e for e in xs if e["name"] == "request")
+    kid_names = {
+        e["name"] for e in xs
+        if e["args"].get("parent_id") == root["args"]["span_id"]
+    }
+    assert {"queue", "dispatch", "sync"} <= kid_names
+
+
+def test_chrome_trace_skips_malformed_spans_never_raises():
+    trace = tracing.to_chrome_trace([
+        {"kind": "span", "name": "ok", "start_ms": 1.0, "dur_ms": 2.0},
+        {"kind": "span", "name": "no_times"},
+        {"kind": "span", "start_ms": 1.0, "dur_ms": 2.0},  # no name
+        {"kind": "span", "name": "bad", "start_ms": "x", "dur_ms": 1.0},
+    ])
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["ok"]
+
+
+# -- the critical-path summary ----------------------------------------------
+
+
+def test_critical_path_summary_decomposition():
+    records = _request_tree_records()
+    summary = tracing.critical_path_summary(records)
+    # flat profile covers every name
+    for name in ("request", "queue", "dispatch", "sync",
+                 "train_dispatch"):
+        assert summary["by_name"][name]["count"] == 1
+        assert summary["by_name"][name]["mean_ms"] >= 0
+    # the serving decomposition keys by (program, bucket, shots); queue
+    # and request (pre-grouping) key by shots only
+    sv = summary["serving"]
+    assert "adapt/b2/s1" in sv
+    row = sv["adapt/b2/s1"]
+    assert row["dispatch_count"] == 1 and row["sync_count"] == 1
+    assert row["dispatch_ms_mean"] >= 1.0  # the sleep
+    assert row["stages_ms"] >= row["dispatch_ms_mean"]
+    assert "*/b*/s1" in sv
+    assert sv["*/b*/s1"]["queue_count"] == 1
+    assert sv["*/b*/s1"]["requests"] == 1
+    assert sv["*/b*/s1"]["request_ms_mean"] >= 2.0  # both sleeps
+
+
+def test_span_records_filter():
+    spans = tracing.span_records([
+        {"kind": "span", "name": "a"},
+        {"kind": "epoch", "epoch": 1},
+        {"kind": "span", "name": "b"},
+    ])
+    assert [s["name"] for s in spans] == ["a", "b"]
