@@ -46,10 +46,14 @@ closed-loop load generator for the adapt-on-request serving engine: it
 drives mixed-bucket synthetic traffic through a ``ServingEngine`` under a
 strict retrace gate and prints one JSON line with adaptation-latency
 p50/p95, tenants/sec, per-dispatch H2D bytes and cache hit rate
-(optionally writing schema-v9 ``serving`` telemetry records with
+(optionally writing schema-v11 ``serving`` telemetry records with
 ``--telemetry PATH``; ``--ingest {f32,uint8,index}`` selects the ingest
 tier, ``--repeat-tenant-fraction`` mixes adapted-params-cache hits in,
-``--export-dir`` warms from AOT artifacts). The ``serve-export``
+``--export-dir`` warms from AOT artifacts, ``--replicas N`` drives an
+N-replica shared-nothing pool through the cache-affinity router — the
+line gains aggregate + per-replica throughput — and ``--rollover``
+exercises the zero-downtime checkpoint-rollover lifecycle mid-load,
+serving/replica.py + router.py + refresh.py). The ``serve-export``
 subcommand (serving/export.py — needs jax) writes those artifacts: the
 warmed (bucket x shots) program ladder serialized to a versioned dir
 keyed by device-kind/dtype/config-fingerprint, which a later engine

@@ -400,6 +400,26 @@ class MAMLConfig:
     # of compiling, falling back to compile-then-save on any mismatch;
     # `cli serve-export` writes the artifacts ahead of time. '' disables.
     serving_export_dir: str = ""
+    # multi-replica scale-out (serving/replica.py): how many shared-
+    # nothing serving replicas a ReplicaSet builds — the visible devices
+    # are partitioned into this many DISJOINT slices, one full engine
+    # (own program ladder, own adapted-params cache, own micro-batcher)
+    # per slice. 1 (default) is the single-engine shape; on CPU/CI extra
+    # replicas come from --xla_force_host_platform_device_count (the
+    # serve-bench --replicas path forces it), so the pool is testable
+    # without a TPU.
+    serving_replicas: int = 1
+    # cache-affinity router (serving/router.py): a request is routed to
+    # its HOME replica (stable content hash of its adapted-cache key) so
+    # LRU hit rates survive scale-out; when the home replica's micro-
+    # batcher backlog reaches this depth, the request spills over to the
+    # least-loaded healthy replica instead (a cold adapt there beats
+    # queueing behind a saturated home). Must be >= 1.
+    serving_router_spill_depth: int = 8
+    # checkpoint-rollover refresh daemon (serving/refresh.py): how often
+    # the daemon polls the experiment checkpoint dir for a new snapshot
+    # to pre-warm into the standby slot and swap in. Must be > 0.
+    serving_rollover_poll_s: float = 5.0
 
     # --- static analysis (analysis/) --------------------------------------
     # program-contract audits + runtime retrace detection:
@@ -688,6 +708,39 @@ class MAMLConfig:
                 "serving_adapted_cache_size must be an int >= 0 (0 "
                 "disables the adapted-params cache), got "
                 f"{self.serving_adapted_cache_size!r}"
+            )
+        # multi-replica / router / rollover knobs (same integral-float
+        # coercion as the other serving ints — JSON round-trips)
+        for knob in ("serving_replicas", "serving_router_spill_depth"):
+            v = getattr(self, knob)
+            if isinstance(v, float) and v.is_integer():
+                setattr(self, knob, int(v))
+        if not (
+            isinstance(self.serving_replicas, int)
+            and not isinstance(self.serving_replicas, bool)
+            and self.serving_replicas >= 1
+        ):
+            raise ValueError(
+                "serving_replicas must be an int >= 1 (each replica owns "
+                "a disjoint device slice; 1 is the single-engine shape), "
+                f"got {self.serving_replicas!r}"
+            )
+        if not (
+            isinstance(self.serving_router_spill_depth, int)
+            and not isinstance(self.serving_router_spill_depth, bool)
+            and self.serving_router_spill_depth >= 1
+        ):
+            raise ValueError(
+                "serving_router_spill_depth must be an int >= 1 (the "
+                "home-replica backlog at which affinity routing spills "
+                "to the least-loaded healthy replica), got "
+                f"{self.serving_router_spill_depth!r}"
+            )
+        if not self.serving_rollover_poll_s > 0:
+            raise ValueError(
+                "serving_rollover_poll_s must be > 0 (how often the "
+                "refresh daemon polls the checkpoint dir for rollover), "
+                f"got {self.serving_rollover_poll_s!r}"
             )
         if self.analysis_level not in ("off", "warn", "strict"):
             raise ValueError(

@@ -578,15 +578,27 @@ def load_checkpoint(
 
 
 def peek_experiment_state(
-    model_save_dir: str, model_name: str, model_idx
+    model_save_dir: str, model_name: str, model_idx,
+    readonly: bool = False,
 ) -> Optional[Dict[str, Any]]:
     """The experiment-state dict of a checkpoint WITHOUT restoring the
     array pytree (None when the checkpoint or its JSON is absent/corrupt).
     The resume logic uses this to compare ``current_iter`` across the
-    ``latest`` and ``emergency`` candidates before paying a restore."""
+    ``latest`` and ``emergency`` candidates before paying a restore.
+
+    :param readonly: never mutate the checkpoint directory — the
+        serving-side contract (``_resolve_readonly_path``): a reader of
+        a LIVE training run's dir (the rollover refresh daemon polls
+        this every few seconds) must not perform the ``.old`` recovery
+        rename — racing the trainer's two-rename swap from a second
+        process can crash the trainer's save with a non-empty
+        destination. The training-owned default keeps the recovery."""
     path = _ckpt_dir(model_save_dir, model_name, model_idx)
     wait_for_pending(touching=path)
-    _recover_interrupted_swap(path)
+    if readonly:
+        path = _resolve_readonly_path(path)
+    else:
+        _recover_interrupted_swap(path)
     try:
         with open(os.path.join(path, _EXPERIMENT_STATE_FILE)) as f:
             return json.load(f)

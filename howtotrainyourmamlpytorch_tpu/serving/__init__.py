@@ -24,9 +24,21 @@ request-driven hot path:
 * :mod:`serving.export`  — the ``cli serve-export`` AOT artifact writer
   (``jax.experimental.serialize_executable`` payloads keyed by
   device-kind/dtype/config-fingerprint);
+* :mod:`serving.replica` — horizontal scale-out: ``ReplicaSet``
+  partitions the visible devices into disjoint slices and runs one
+  full engine (+ micro-batcher + adapted-params cache) per slice,
+  each tagging its telemetry with a ``replica_id``;
+* :mod:`serving.router`  — the shared-nothing front tier:
+  cache-affinity routing (stable support-set fingerprint -> home
+  replica, so LRU hit rates survive scale-out), queue-depth spillover
+  and per-replica circuit breaking;
+* :mod:`serving.refresh` — the checkpoint-rollover refresh daemon:
+  watches the experiment dir, pre-warms each new snapshot into a
+  standby engine off the hot path and swaps replicas one at a time
+  (zero dropped requests, zero XLA compiles at swap time);
 * :mod:`serving.bench`   — the ``cli serve-bench`` closed-loop load
   generator (latency p50/p95 + tenants/sec + H2D bytes + cache hit
-  rate, telemetry ``serving`` records).
+  rate + ``--replicas`` pool scaling, telemetry ``serving`` records).
 """
 
 from .batcher import AdaptRequest, IndexRequest, MicroBatcher, serve_requests
@@ -36,6 +48,9 @@ from .engine import (
     load_servable_snapshot,
 )
 from .metrics import FanoutSink, MetricsServer, ServingMetrics
+from .refresh import RefreshDaemon
+from .replica import Replica, ReplicaSet, partition_devices
+from .router import ReplicaRouter, home_replica, request_fingerprint
 
 __all__ = [
     "AdaptRequest",
@@ -43,9 +58,16 @@ __all__ = [
     "IndexRequest",
     "MetricsServer",
     "MicroBatcher",
+    "RefreshDaemon",
+    "Replica",
+    "ReplicaRouter",
+    "ReplicaSet",
     "ServingEngine",
     "ServingMetrics",
     "attach_serving_watchdog",
+    "home_replica",
     "load_servable_snapshot",
+    "partition_devices",
+    "request_fingerprint",
     "serve_requests",
 ]

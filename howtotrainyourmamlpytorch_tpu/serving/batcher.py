@@ -87,6 +87,55 @@ class IndexRequest:
         return int(np.asarray(self.support_idx).shape[1])
 
 
+def update_support_digest(h, request) -> None:
+    """Feed EXACTLY a request's adaptation-identity content — the
+    support set, in index or pixel form, with shapes/dtype — into the
+    hashlib object ``h``. THE shared recipe with two consumers: the
+    engine's adapted-params cache key (``ServingEngine._cache_key``:
+    this content + shots + the engine-local snapshot salt) and the
+    router's affinity fingerprint
+    (``serving.router.request_fingerprint``: this content alone — the
+    shots and salt suffixes are deliberately router-excluded). Affinity
+    routing only preserves pool cache-hit rates while the router's
+    identity keeps covering the cache identity's CONTENT core, so any
+    content field added to the cache key must be added here — one
+    recipe keeps them in lockstep by construction."""
+    support_idx = getattr(request, "support_idx", None)
+    if support_idx is not None:
+        si = np.ascontiguousarray(np.asarray(support_idx, np.int64))
+        h.update(b"index|")
+        h.update(str(si.shape).encode())
+        h.update(si)
+    else:
+        sx = np.ascontiguousarray(np.asarray(request.support_x))
+        sy = np.ascontiguousarray(
+            np.asarray(request.support_y, np.int64)
+        )
+        h.update(b"pixel|")
+        h.update(str(sx.shape).encode())
+        h.update(str(sx.dtype).encode())
+        h.update(sx)
+        h.update(sy)
+
+
+def engine_ready(engine) -> bool:
+    """True when an engine (or a ``serving.replica.Replica`` proxying
+    one) can serve a dispatch right now without dying or paying a
+    cold-start compile bill: it completed ``warmup()`` OR has already
+    served traffic (a lazily-compiled engine that never called warmup()
+    but has been dispatching keeps the drain guarantee), and has not
+    died mid-dispatch — the gate between ``close()``'s
+    drain-the-backlog semantics and the immediate shutdown a
+    broken/never-started replica needs."""
+    return (
+        getattr(engine, "_dead", None) is None
+        and (
+            bool(getattr(engine, "warmup_stats", None))
+            or getattr(engine, "_tenants_served", 0) > 0
+        )
+    )
+
+
 def group_requests(
     requests: Sequence[AdaptRequest], max_tenants: int
 ) -> List[List[int]]:
@@ -200,10 +249,28 @@ class MicroBatcher:
         self._request_ids = itertools.count(1)
         self._cond = threading.Condition()
         self._closed = False
+        # close() normally DRAINS (serves every queued request before the
+        # worker exits); a close against a never-warmed or dead engine
+        # flips this off so shutdown is immediate — dispatching there
+        # would pay the full lazy-compile bill (or a doomed dispatch)
+        # just to tear the replica down (the circuit-breaker drain path)
+        self._drain_on_close = True
         self._worker = threading.Thread(
             target=self._run, name="serving-batcher", daemon=True
         )
         self._worker.start()
+
+    def queue_depth(self) -> int:
+        """Current backlog across every shots queue — the router's
+        spillover signal and the metrics queue-depth gauge."""
+        with self._cond:
+            return sum(len(q) for q in self._queues.values())
+
+    @property
+    def worker_alive(self) -> bool:
+        """False once the worker thread crashed or exited — a replica
+        whose dispatcher died must read unhealthy to the router."""
+        return self._worker.is_alive()
 
     def submit(self, request: AdaptRequest) -> _Pending:
         # validate HERE, against the engine geometry, so a malformed
@@ -237,7 +304,7 @@ class MicroBatcher:
             self.metrics.observe_queue_depth(depth)
         return pending
 
-    def close(self) -> None:
+    def close(self, drain: Optional[bool] = None) -> None:
         """Drain every queue, then stop the worker thread.
 
         In-flight requests at close() are SERVED (the worker dispatches
@@ -248,15 +315,30 @@ class MicroBatcher:
         the queues after the join as a final safety net (a dead worker's
         join returns immediately, which previously stranded its queued
         futures forever).
+
+        ``drain`` defaults to auto: when the engine never completed
+        ``warmup()`` or is already dead, the drain dispatches are
+        SKIPPED and shutdown is immediate — serving the backlog there
+        would block the join on the full lazy-compile bill (or a doomed
+        post-donation dispatch) just to tear a broken replica down; the
+        queued futures fail promptly with a clear error instead (the
+        circuit-breaker drain semantics, serving/router.py). Pass
+        ``drain=False`` to force the immediate path, ``drain=True`` to
+        force a full drain regardless.
         """
+        if drain is None:
+            drain = engine_ready(self.engine)
         with self._cond:
             self._closed = True
+            self._drain_on_close = bool(drain)
             self._cond.notify()
         self._worker.join()
         self._fail_pending(
             RuntimeError(
                 "MicroBatcher closed before this request could be served "
-                "(worker exited early)"
+                + ("(worker exited early)" if drain else
+                   "(engine never warmed or is dead — close skipped the "
+                   "drain dispatches for an immediate shutdown)")
             )
         )
 
@@ -282,6 +364,10 @@ class MicroBatcher:
         queues, so a saturated low-shots queue can never starve another
         shots bucket past its max-wait promise (caller holds the lock);
         None when nothing is ripe yet."""
+        if self._closed and not self._drain_on_close:
+            # immediate shutdown: nothing is ripe — the worker exits and
+            # close() fails the backlog instead of dispatching it
+            return None
         now = time.perf_counter()
         ripe_shots, oldest = None, None
         for shots, q in self._queues.items():

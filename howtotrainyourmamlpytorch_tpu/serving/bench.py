@@ -40,6 +40,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -165,6 +166,124 @@ def _synth_groups(cfg, shots_buckets, n_requests: int, cap: int,
     return groups
 
 
+class _DeviceOccupancyShim:
+    """CPU replica-emulation (``--emulate-device-ms``): proxy one
+    replica's engine and hold its dispatch slot for a fixed extra
+    window after each ``serve_group`` — the host-side shape of a real
+    accelerator dispatch, where the host thread BLOCKS (GIL released,
+    core yielded) while the device computes. One replica serializes
+    compute + occupancy; N replicas overlap their occupancy windows,
+    which is exactly the scaling a real per-device pool exhibits and
+    the only scaling observable on a CI box whose XLA:CPU "devices"
+    all contend for the same physical core(s). The sleep runs inside
+    the replica's swap lock (it proxies the engine the ``Replica``
+    dispatches through), so rollover swaps still wait out the full
+    emulated dispatch — the zero-drop semantics are exercised
+    unchanged."""
+
+    def __init__(self, engine, hold_ms: float):
+        self._engine = engine
+        self._hold_s = float(hold_ms) / 1e3
+
+    def serve_group(self, requests, queue_ms: float = 0.0):
+        out = self._engine.serve_group(requests, queue_ms=queue_ms)
+        time.sleep(self._hold_s)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def _drive_pool(args, cfg, pool, router, requests, state, sink):
+    """Drive the replica pool open-loop (and, under ``--rollover``,
+    roll a new checkpoint through it MID-LOAD). Returns
+    ``{"dropped_requests": n, "rollover": block-or-None}`` — the
+    zero-downtime acceptance surface: every submitted future must
+    resolve, and every swap must report zero XLA compiles."""
+    import shutil
+    import tempfile
+
+    daemon = None
+    scratch = None
+    save_dir = None
+    stats = None
+    if args.rollover:
+        from ..experiment import checkpoint as ckpt
+        from .refresh import RefreshDaemon
+
+        scratch = tempfile.mkdtemp(prefix="serve_bench_rollover_")
+        save_dir = os.path.join(scratch, "saved_models")
+        os.makedirs(save_dir, exist_ok=True)
+        ckpt.save_checkpoint(
+            save_dir, "train_model", "latest", state, {"current_iter": 0}
+        )
+        daemon = RefreshDaemon(
+            pool, cfg, save_dir, poll_s=0.05, sink=sink
+        )
+        daemon.prime()
+    pendings = [router.submit(r) for r in requests]
+    if daemon is not None:
+        # write a NEW checkpoint while the pool serves the backlog,
+        # then roll on a BACKGROUND thread while this thread keeps
+        # waves of live submissions flowing until every swap landed —
+        # on any machine speed the swaps contend with real in-flight
+        # dispatches (a fast runner could otherwise drain the first
+        # wave before the standby even starts warming, making the
+        # zero-drop assertion vacuous), and the post-rollover waves
+        # prove traffic flows on the fresh snapshot
+        import threading
+
+        from ..experiment import checkpoint as ckpt
+
+        ckpt.save_checkpoint(
+            save_dir, "train_model", "latest", state, {"current_iter": 1}
+        )
+        roll_result = []
+        roller = threading.Thread(
+            target=lambda: roll_result.append(daemon.poll_once()),
+            name="serve-bench-rollover",
+        )
+        roller.start()
+        while roller.is_alive():
+            wave = [router.submit(r) for r in requests]
+            pendings += wave
+            for p in wave:
+                try:
+                    p.get(timeout=600)
+                except Exception:  # noqa: BLE001 - counted below
+                    pass
+        roller.join()
+        stats = roll_result[0] if roll_result else None
+    dropped = 0
+    for p in pendings:
+        try:
+            p.get(timeout=600)
+        except Exception:  # noqa: BLE001 - counted, reported, asserted 0
+            dropped += 1
+    block = None
+    if daemon is not None:
+        swaps = stats or []
+        block = {
+            "rollovers": daemon.rollovers,
+            "swaps": len(swaps),
+            "xla_compiles_at_swap": sum(
+                s.get("xla_compiles_at_swap", 0) for s in swaps
+            ),
+            "swap_ms_max": (
+                max(s.get("swap_ms", 0.0) for s in swaps) if swaps
+                else None
+            ),
+            "standby_warmup_modes": sorted(
+                {str(s.get("standby_warmup_mode")) for s in swaps}
+            ),
+            "rollover_error": (
+                repr(daemon.last_error) if daemon.last_error else None
+            ),
+        }
+        shutil.rmtree(scratch, ignore_errors=True)
+    return {"dropped_requests": dropped, "rollover": block}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="serve-bench",
@@ -234,6 +353,54 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "captures a jax.profiler trace of the next "
                              "N serving dispatches (see utils.profiling."
                              "OnDemandProfiler)")
+    parser.add_argument("--replicas", type=int, default=None, metavar="N",
+                        help="drive an N-replica shared-nothing pool "
+                             "(serving/replica.py) through the cache-"
+                             "affinity router instead of one engine: "
+                             "requests are submitted open-loop to the "
+                             "per-replica micro-batchers and the line "
+                             "reports the POOL aggregate tenants_per_sec "
+                             "+ per-replica rollups. On CPU the host "
+                             "platform is forced to N virtual devices "
+                             "(one disjoint device per replica) before "
+                             "jax loads — the TPU-free smoke protocol")
+    parser.add_argument("--spill-depth", type=int, default=None,
+                        metavar="D",
+                        help="router spillover depth override (default "
+                             "for the bench: the request count, i.e. "
+                             "spillover OFF — the closed-loop generator "
+                             "saturates every queue by construction, so "
+                             "depth-based spilling would only randomize "
+                             "placement and dilute the cache-affinity "
+                             "measurement; pass a small D to measure "
+                             "spillover itself)")
+    parser.add_argument("--rollover", action="store_true",
+                        help="exercise zero-downtime checkpoint rollover "
+                             "MID-LOAD (requires --replicas): the bench "
+                             "saves a checkpoint into a scratch "
+                             "experiment dir, points a RefreshDaemon at "
+                             "it, writes a NEW checkpoint while the pool "
+                             "is serving, and rolls every replica onto "
+                             "it — the line gains a `rollover` block "
+                             "(swaps, swap compiles — must be 0 — and "
+                             "dropped requests — must be 0)")
+    parser.add_argument("--emulate-device-ms", type=float, default=0.0,
+                        metavar="MS",
+                        help="CPU replica-emulation recipe (requires "
+                             "--replicas): hold each replica's dispatch "
+                             "slot for MS extra milliseconds after the "
+                             "XLA work — the host-side shape of a real "
+                             "accelerator dispatch, where the host "
+                             "BLOCKS while the device computes. On a "
+                             "TPU pool this is what makes replicas "
+                             "scale (each blocks on its OWN device); "
+                             "on a shared-core CI box it is the only "
+                             "way pool orchestration scaling is "
+                             "observable at all: XLA:CPU compute from "
+                             "all replicas contends for the same "
+                             "core(s) and cannot scale, but the "
+                             "occupancy window overlaps perfectly. "
+                             "0 (default) disables the shim")
     args = parser.parse_args(argv)
     if args.trace and not args.telemetry:
         parser.error("--trace requires --telemetry: span records ride "
@@ -248,6 +415,33 @@ def main(argv: Optional[List[str]] = None) -> int:
             "(a mismatched default config would fail the restore — or, "
             "worse, silently serve with the wrong inner-step count)"
         )
+    if args.rollover and args.replicas is None:
+        parser.error("--rollover requires --replicas (the rollover "
+                     "lifecycle is a pool operation; use --replicas 1 "
+                     "for a single-replica pool)")
+    if args.emulate_device_ms < 0:
+        parser.error("--emulate-device-ms must be >= 0, got "
+                     f"{args.emulate_device_ms}")
+    if args.emulate_device_ms and args.replicas is None:
+        parser.error("--emulate-device-ms requires --replicas (the "
+                     "device-occupancy shim emulates PER-REPLICA "
+                     "device blocking; it has no meaning on the "
+                     "single-engine closed loop)")
+    if args.replicas is not None:
+        if args.replicas < 1:
+            parser.error(f"--replicas must be >= 1, got {args.replicas}")
+        # each replica needs its own disjoint device; on CPU force the
+        # host platform to present enough virtual devices BEFORE jax
+        # first loads (the audit-cli --mesh pattern; no effect on a
+        # backend whose real chips already exist)
+        if "jax" not in sys.modules:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count="
+                      f"{args.replicas}"
+                ).strip()
 
     cfg = _bench_cfg(args)
     n_requests = args.requests or (8 if args.fast else 64)
@@ -275,14 +469,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         sink = JsonlSink(args.telemetry)
     if args.metrics_port is not None:
         # the metrics registry is a telemetry sink teed off the same
-        # record stream the JSONL gets — endpoint and log cannot disagree
-        from .metrics import FanoutSink, MetricsServer, ServingMetrics
+        # record stream the JSONL gets — endpoint and log cannot
+        # disagree (the HTTP server itself starts AFTER the engine/pool
+        # exists, so /healthz can report pool readiness)
+        from .metrics import FanoutSink, ServingMetrics
 
         metrics = ServingMetrics()
         sink = FanoutSink(sink, metrics) if sink is not None else metrics
-        metrics_server = MetricsServer(metrics, port=args.metrics_port)
-        print(f"serve-bench: metrics at {metrics_server.url}",
-              file=sys.stderr, flush=True)
 
     tracer = None
     if args.trace:
@@ -317,31 +510,126 @@ def main(argv: Optional[List[str]] = None) -> int:
             cache_size = max(64, n_requests)
     store = _synth_store(cfg) if ingest == "index" else None
 
-    engine = ServingEngine(
-        cfg, state, shots_buckets=shots_buckets, sink=sink,
-        strict_retrace=True, ingest=ingest, store=store,
-        cache_size=cache_size, tracer=tracer, profiler=profiler,
-    )
-    watchdog = None
-    if cfg.watchdog_timeout_s > 0:
-        # a wedged serving dispatch must produce a watchdog_stall record,
-        # not a silent hang — same contract as the train loop
-        from .engine import attach_serving_watchdog
+    pool = None
+    router = None
+    pool_drive = None
+    if args.replicas is not None:
+        # the multi-replica protocol: one full engine per disjoint
+        # device, requests routed by cache affinity, OPEN-LOOP
+        # submission into the per-replica micro-batchers — the aggregate
+        # tenants_per_sec is total tenants over the union wall-clock
+        # span (serving/replica.py rollup)
+        from .replica import ReplicaSet
+        from .router import ReplicaRouter
 
-        watchdog = attach_serving_watchdog(
-            engine, cfg.watchdog_timeout_s, sink=sink,
+        if profiler is not None:
+            print("serve-bench: --profile-request applies to the "
+                  "single-engine path; ignored under --replicas",
+                  file=sys.stderr, flush=True)
+        if cfg.watchdog_timeout_s > 0:
+            # the PR-14 watchdog wraps ONE engine's dispatch heartbeat;
+            # per-replica watchdogs (which must survive rollover engine
+            # swaps) are future work — say so instead of silently
+            # dropping the knob
+            print("serve-bench: watchdog_timeout_s applies to the "
+                  "single-engine path; NOT wired under --replicas "
+                  "(per-replica watchdogs are future work)",
+                  file=sys.stderr, flush=True)
+        import jax
+
+        pool_devices = None
+        if (jax.default_backend() == "cpu"
+                and len(jax.devices()) > args.replicas):
+            # virtual host devices beyond the pool width are
+            # meaningless (an already-initialized jax, e.g. in-process
+            # tests, may present more than --replicas forced): take
+            # width-1 slices. On a real accelerator the pool partitions
+            # every chip and warns about idle capacity instead.
+            pool_devices = list(jax.devices())[:args.replicas]
+        pool = ReplicaSet(
+            cfg, state, n_replicas=args.replicas, devices=pool_devices,
+            shots_buckets=shots_buckets, sink=sink, strict_retrace=True,
+            ingest=ingest, store=store, cache_size=cache_size,
+            tracer=tracer, metrics=metrics, export_root=args.export_dir,
         )
-    warmup_s = engine.warmup(artifact_dir=args.export_dir)
+        engine = pool.replicas[0].engine  # line metadata (shared knobs)
+        if args.metrics_port is not None:
+            from .metrics import MetricsServer
 
-    groups = _synth_groups(
-        cfg, shots_buckets, n_requests, engine.max_tenants, args.seed,
-        ingest=ingest, store_rows=engine._store_rows,
-        repeat_fraction=args.repeat_tenant_fraction,
-    )
-    for group in groups:
-        serve_requests(engine, group)
+            metrics_server = MetricsServer(
+                metrics, port=args.metrics_port,
+                readiness=pool.readiness,
+            )
+            print(f"serve-bench: metrics at {metrics_server.url}",
+                  file=sys.stderr, flush=True)
+        warmup_s = pool.warmup()
+        if args.emulate_device_ms:
+            # shim AFTER warmup (compiles must stay un-padded) and shim
+            # the rollover standby builder too, so swapped-in engines
+            # keep the same emulated occupancy as the ones they replace
+            for r in pool.replicas:
+                r.engine = _DeviceOccupancyShim(
+                    r.engine, args.emulate_device_ms
+                )
+            _build = pool.build_standby_engine
 
-    rollup = engine.rollup()
+            def _shimmed_standby(rid, st, snapshot_id=None):
+                return _DeviceOccupancyShim(
+                    _build(rid, st, snapshot_id), args.emulate_device_ms
+                )
+
+            pool.build_standby_engine = _shimmed_standby
+        # spillover default: OFF for the closed-loop generator (every
+        # queue is saturated by construction, so depth spilling would
+        # only randomize placement and dilute the affinity measurement)
+        spill = (
+            args.spill_depth if args.spill_depth is not None
+            else max(cfg.serving_router_spill_depth, n_requests)
+        )
+        router = ReplicaRouter(pool, spill_depth=spill)
+        groups = _synth_groups(
+            cfg, shots_buckets, n_requests, engine.max_tenants,
+            args.seed, ingest=ingest, store_rows=engine._store_rows,
+            repeat_fraction=args.repeat_tenant_fraction,
+        )
+        requests = [r for g in groups for r in g]
+        pool_drive = _drive_pool(args, cfg, pool, router, requests,
+                                 state, sink)
+        rollup = pool.rollup()
+        pool.close()
+    else:
+        engine = ServingEngine(
+            cfg, state, shots_buckets=shots_buckets, sink=sink,
+            strict_retrace=True, ingest=ingest, store=store,
+            cache_size=cache_size, tracer=tracer, profiler=profiler,
+        )
+        if args.metrics_port is not None:
+            from .metrics import MetricsServer
+
+            metrics_server = MetricsServer(metrics, port=args.metrics_port)
+            print(f"serve-bench: metrics at {metrics_server.url}",
+                  file=sys.stderr, flush=True)
+    watchdog = None
+    if args.replicas is None:
+        if cfg.watchdog_timeout_s > 0:
+            # a wedged serving dispatch must produce a watchdog_stall
+            # record, not a silent hang — same contract as the train loop
+            from .engine import attach_serving_watchdog
+
+            watchdog = attach_serving_watchdog(
+                engine, cfg.watchdog_timeout_s, sink=sink,
+            )
+        warmup_s = engine.warmup(artifact_dir=args.export_dir)
+
+        groups = _synth_groups(
+            cfg, shots_buckets, n_requests, engine.max_tenants, args.seed,
+            ingest=ingest, store_rows=engine._store_rows,
+            repeat_fraction=args.repeat_tenant_fraction,
+        )
+        for group in groups:
+            serve_requests(engine, group)
+
+        rollup = engine.rollup()
     if profiler is not None:
         profiler.close()
     if watchdog is not None:
@@ -390,6 +678,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         "max_tenants_per_dispatch": engine.max_tenants,
         "fast": bool(args.fast),
     }
+    if pool is not None:
+        # the pool surface: aggregate tenants_per_sec is total tenants
+        # over the UNION wall-clock span (never a sum of per-replica
+        # rates — their spans overlap), per-replica rollups ride along,
+        # and the router reports how affinity/spillover placed traffic
+        line["replicas"] = rollup["replicas"]
+        line["per_replica"] = [
+            {
+                "replica_id": ru["replica_id"],
+                "dispatches": ru["dispatches"],
+                "tenants": ru["tenants"],
+                "adapt_ms_p50": ru["adapt_ms_p50"],
+                "tenants_per_sec": ru["tenants_per_sec"],
+                "cache_hit_rate": ru["cache_hit_rate"],
+            }
+            for ru in rollup["per_replica"]
+        ]
+        line["router"] = router.stats()
+        line["dropped_requests"] = pool_drive["dropped_requests"]
+        line["rollover"] = pool_drive["rollover"]
+        line["emulate_device_ms"] = args.emulate_device_ms
+        # every replica warmed; the line's single warmup fields reflect
+        # replica 0, the totals say whether ANY replica compiled
+        line["warmup_xla_compiles_total"] = sum(
+            r.engine.warmup_stats.get("xla_compiles", 0)
+            for r in pool.replicas
+        )
     import jax
 
     line["backend"] = jax.default_backend()

@@ -227,6 +227,17 @@ class ServingEngine:
         once per (non-warmup) dispatch, so an operator can capture a
         ``jax.profiler`` trace of the next N serving dispatches by
         touching the trigger file, with no restart.
+    :param device: pin this engine to ONE ``jax.Device`` (the
+        multi-replica shape, serving/replica.py: each replica's engine
+        owns a disjoint device). The snapshot (and registered store) are
+        placed there, and every program is AOT-compiled against that
+        device's sharding, so concurrent replicas dispatch onto
+        concurrent devices. Default (None) keeps the process-default
+        device — the single-engine shape, byte-for-byte unchanged.
+    :param replica_id: tag every telemetry record this engine emits
+        with a ``replica_id`` (schema v11) so a multi-replica pool's
+        merged record stream stays attributable per replica. Default
+        (None) omits the field — single-engine logs are unchanged.
     """
 
     #: latency-sample window for the rollup percentiles (last N
@@ -247,6 +258,8 @@ class ServingEngine:
         tracer: Optional[tracing.Tracer] = None,
         watchdog=None,
         profiler=None,
+        device=None,
+        replica_id: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -258,6 +271,8 @@ class ServingEngine:
         # the listener before any serving program can compile
         export_lib.install_compile_counter()
         self.cfg = cfg
+        self.device = device
+        self.replica_id = replica_id
         self.buckets: Tuple[int, ...] = tuple(cfg.serving_bucket_ladder)
         self.max_tenants: int = cfg.serving_max_tenants_per_dispatch
         self.shots_buckets: Tuple[int, ...] = tuple(
@@ -288,10 +303,18 @@ class ServingEngine:
         # state and re-binds to the (aliased) returned one, so the buffers
         # must be private — ``jnp.array(copy=True)`` (plain device_put is
         # a no-op for an already-committed array and would donate the
-        # CALLER's buffers out from under it)
-        self._state = jax.tree_util.tree_map(
-            lambda x: jnp.array(x, copy=True), state
-        )
+        # CALLER's buffers out from under it). A device-pinned engine
+        # routes the copy through the host so the private buffers land on
+        # ITS device regardless of where the caller's snapshot lives.
+        if device is not None:
+            self._state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(np.array(np.asarray(x)), device),
+                state,
+            )
+        else:
+            self._state = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True), state
+            )
         # 'index' ingest: the registered store is uploaded ONCE and is a
         # program parameter of every dispatch (never donated — the
         # resident invariant, exactly like the indexed train factories)
@@ -319,7 +342,10 @@ class ServingEngine:
                 store_fp = hashlib.sha1(
                     np.ascontiguousarray(data)
                 ).hexdigest()
-            self._store = jnp.asarray(data)
+            self._store = (
+                jax.device_put(data, device) if device is not None
+                else jnp.asarray(data)
+            )
         elif store is not None:
             raise ValueError(
                 f"a registered store only applies to ingest='index' "
@@ -517,6 +543,18 @@ class ServingEngine:
     def _abstract(self, tree):
         import jax
 
+        if self.device is not None:
+            # a device-pinned engine AOT-compiles against ITS device:
+            # the sharding on the abstract args is what targets the
+            # executable (uncommitted numpy dispatch args then follow
+            # the executable's device, committed state/store must match)
+            sharding = jax.sharding.SingleDeviceSharding(self.device)
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    tuple(x.shape), x.dtype, sharding=sharding
+                ),
+                tree,
+            )
         return jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype), tree
         )
@@ -638,10 +676,16 @@ class ServingEngine:
         self._warming = True
         cache_on = self.cache_size > 0
         names = self._program_names()
-        extra = (
-            {"store_rows": self._store_rows}
-            if self.ingest == "index" else None
-        )
+        extra: Dict[str, Any] = {}
+        if self.ingest == "index":
+            extra["store_rows"] = self._store_rows
+        if self.device is not None:
+            # serialized executables record their device assignment by
+            # id; a device-pinned engine must only deserialize artifacts
+            # written for ITS device (replicas keep per-replica artifact
+            # roots — serving/replica.py), never another replica's
+            extra["device_id"] = int(self.device.id)
+        extra = extra or None
         mode = "compile"
         if artifact_dir:
             loaded = export_lib.load_artifacts(
@@ -861,20 +905,15 @@ class ServingEngine:
         """Tenant support-set fingerprint: content hash + shots +
         snapshot id (the salt). A changed support set, shots count,
         checkpoint, ingest tier or registered store produces a different
-        key by construction."""
+        key by construction. The support-content recipe is SHARED with
+        the router's affinity fingerprint (``update_support_digest``) —
+        affinity routing only preserves pool hit rates while the two
+        identities match, so they hash the same bytes by construction."""
+        from .batcher import update_support_digest
+
         h = hashlib.sha1(self._cache_salt)
         h.update(str(shots).encode())
-        if self.ingest == "index":
-            si = np.ascontiguousarray(np.asarray(req.support_idx, np.int64))
-            h.update(str(si.shape).encode())
-            h.update(si)
-        else:
-            sx = np.ascontiguousarray(np.asarray(req.support_x))
-            sy = np.ascontiguousarray(np.asarray(req.support_y, np.int64))
-            h.update(str(sx.shape).encode())
-            h.update(str(sx.dtype).encode())
-            h.update(sx)
-            h.update(sy)
+        update_support_digest(h, req)
         return h.hexdigest()
 
     def _cache_insert(self, key: str, fast: Dict[str, np.ndarray]) -> None:
@@ -1071,7 +1110,47 @@ class ServingEngine:
             return
         from ..telemetry.sinks import make_record
 
+        if self.replica_id is not None:
+            # schema v11: a pooled engine tags its records so the merged
+            # stream stays attributable per replica (single-engine logs
+            # are unchanged — the field is simply absent)
+            fields.setdefault("replica_id", self.replica_id)
         self.sink.write(make_record("serving", **fields))
+
+    def adopt_serving_history(self, old) -> None:
+        """Carry a retired engine's serving-history counters into this
+        one (the checkpoint-rollover swap, serving/replica.py): the
+        per-replica rollup describes the REPLICA's serving history, so
+        tenants served, the latency windows, the cache hit/miss
+        counters and the wall-clock span must survive an engine swap
+        instead of resetting with each snapshot — without it a
+        mid-load rollover silently discards every pre-swap dispatch
+        from the bench line. Called under the replica's swap lock
+        (both engines quiescent)."""
+        self._tenants_served += old._tenants_served
+        for name in ("_adapt_ms", "_queue_ms", "_h2d_bytes",
+                     "_batch_ms", "_dispatch_ms", "_sync_ms"):
+            dst = getattr(self, name)
+            merged = list(getattr(old, name)) + list(dst)
+            dst.clear()
+            dst.extend(merged)  # deque maxlen keeps the window honest
+        self.cache_hits += old.cache_hits
+        self.cache_misses += old.cache_misses
+        # the retrace history survives too: a pre-swap retrace must not
+        # vanish from the rollup's 'retraces == 0 in any healthy run'
+        # surface just because the snapshot rolled
+        self.retrace_detector.events.extend(
+            old.retrace_detector.events
+        )
+        if old._span_start is not None and (
+            self._span_start is None
+            or old._span_start < self._span_start
+        ):
+            self._span_start = old._span_start
+        if old._span_end is not None and (
+            self._span_end is None or old._span_end > self._span_end
+        ):
+            self._span_end = old._span_end
 
     def rollup(self) -> Dict[str, Any]:
         """Latency/throughput rollup; emits the event='rollup' telemetry

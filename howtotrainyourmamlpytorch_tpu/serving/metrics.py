@@ -1,7 +1,7 @@
 """Live serving metrics: a Prometheus text-format endpoint (stdlib only).
 
 Production serving needs a scrape surface, not just a JSONL log. This
-module aggregates the SAME schema-v10 ``serving`` telemetry records the
+module aggregates the SAME schema-v11 ``serving`` telemetry records the
 engine already emits — ``ServingMetrics`` is itself a telemetry sink, so
 it tees off the record stream (``FanoutSink``) with zero new
 instrumentation in the hot path and by construction can never disagree
@@ -13,18 +13,33 @@ with the JSONL rollup — and serves them over a background
 * ``serving_cache_hits_total`` / ``serving_cache_lookups_total`` (hit
   rate = the quotient, consistent with the rollup's ``cache_hit_rate``);
 * ``serving_h2d_bytes_total`` — cumulative actual H2D payload;
+* ``serving_rollovers_total`` — checkpoint-rollover swaps observed
+  (serving/refresh.py);
 * ``serving_adapt_latency_ms`` / ``serving_queue_latency_ms`` histograms
   (cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` series — the
   p50/p95 the rollup quotes are recoverable from the same buckets);
 * ``serving_queue_depth`` gauge (the micro-batcher's last observed
   backlog, when a batcher reports it).
 
+**Per-replica labels** (schema v11): records emitted by a pooled engine
+carry a ``replica_id``, and every counter/gauge above keeps one series
+per replica (``{replica="0"}``); records without the field render
+unlabelled, so single-engine deployments scrape exactly what they
+always did. Pool aggregates are label sums — the Prometheus way.
+
+``/healthz`` reports pool readiness: constructed with a ``readiness``
+callable (``ReplicaSet.readiness``), the endpoint answers **503 until
+every replica's warmup completed** (body: one ``replica <id>: ready|
+not-ready`` line each); without one it stays the unconditional 200 of
+the single-engine shape.
+
 Usage (what ``cli serve-bench --metrics-port`` wires)::
 
     metrics = ServingMetrics()
     sink = FanoutSink(JsonlSink(path), metrics)
-    engine = ServingEngine(cfg, state, sink=sink)
-    server = MetricsServer(metrics, port=9090)   # port=0 picks a free one
+    pool = ReplicaSet(cfg, state, sink=sink, metrics=metrics)
+    server = MetricsServer(metrics, port=9090,
+                           readiness=pool.readiness)  # port=0: ephemeral
     ...
     server.close()
 
@@ -35,7 +50,7 @@ from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_MS",
@@ -60,6 +75,43 @@ def _fmt(value: float) -> str:
     if float(value).is_integer():
         return str(int(value))
     return repr(float(value))
+
+
+def _replica_label(record_or_id: Any) -> str:
+    """The label blob for a record's ``replica_id`` ('' when absent —
+    the single-engine unlabelled series)."""
+    if isinstance(record_or_id, dict):
+        rid = record_or_id.get("replica_id")
+    else:
+        rid = record_or_id
+    if rid is None or isinstance(rid, bool) or not isinstance(rid, int):
+        return ""
+    return f'replica="{rid}"'
+
+
+def _render_labeled(
+    name: str, help_text: str, kind: str, series: Mapping[str, float],
+    scalar: bool = True,
+) -> List[str]:
+    """Render one metric family: one line per label blob, '' rendering
+    unlabelled. ``scalar`` families (everything that was a single
+    unlabelled sample pre-pool) ALWAYS emit the unlabelled sample —
+    defaulting to 0 — so the '' series never appears/vanishes across
+    scrapes (a Prometheus counter that disappears breaks rate()
+    continuity) and the single-engine exposition stays byte-identical
+    to the pre-pool output. Non-scalar families (the program-labelled
+    dispatch counter, which pre-pool emitted no sample when empty)
+    render labelled entries only."""
+    lines = [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+    if scalar:
+        lines.append(f"{name} {_fmt(series.get('', 0))}")
+    for labels in sorted(series):
+        value = series[labels]
+        if labels:
+            lines.append(f"{name}{{{labels}}} {_fmt(value)}")
+        elif not scalar:
+            lines.append(f"{name} {_fmt(value)}")
+    return lines
 
 
 class Histogram:
@@ -104,23 +156,31 @@ class ServingMetrics:
     Sink-compatible (``write(record)``): hand it to the engine directly,
     or tee it next to the JSONL sink with ``FanoutSink`` — one record
     stream, two consumers, so the endpoint and the log can never
-    disagree. Thread-safe: the engine's dispatch thread writes while the
-    HTTP thread renders.
+    disagree. Thread-safe: dispatch threads (one per replica in a pool)
+    write while the HTTP thread renders. Counters are keyed by the
+    record's ``replica_id`` label ('' for unlabelled single-engine
+    records); the latency histograms stay pool-aggregate.
     """
 
     def __init__(self,
                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
         self._lock = threading.Lock()
-        self.requests_total = 0
-        self.dispatches_by_program: Dict[str, int] = {}
-        self.cache_hits_total = 0
-        self.cache_lookups_total = 0
-        self.h2d_bytes_total = 0
-        self.retraces_total = 0
-        self.warmups_total = 0
-        self.queue_depth = 0
+        self.requests_total: Dict[str, int] = {}
+        # (program, replica-label) -> dispatch count
+        self.dispatches_by_program: Dict[Tuple[str, str], int] = {}
+        self.cache_hits_total: Dict[str, int] = {}
+        self.cache_lookups_total: Dict[str, int] = {}
+        self.h2d_bytes_total: Dict[str, int] = {}
+        self.retraces_total: Dict[str, int] = {}
+        self.warmups_total: Dict[str, int] = {}
+        self.rollovers_total: Dict[str, int] = {}
+        self.queue_depth: Dict[str, int] = {}
         self.adapt_ms = Histogram(buckets)
         self.queue_ms = Histogram(buckets)
+
+    @staticmethod
+    def _bump(series: Dict[str, int], label: str, by: int) -> None:
+        series[label] = series.get(label, 0) + by
 
     # -- the sink face -----------------------------------------------------
 
@@ -130,14 +190,16 @@ class ServingMetrics:
         if not isinstance(record, dict) or record.get("kind") != "serving":
             return
         event = record.get("event")
+        label = _replica_label(record)
         with self._lock:
             if event == "dispatch":
                 tenants = record.get("tenants")
                 if isinstance(tenants, int):
-                    self.requests_total += tenants
+                    self._bump(self.requests_total, label, tenants)
                 program = str(record.get("program", "adapt"))
-                self.dispatches_by_program[program] = (
-                    self.dispatches_by_program.get(program, 0) + 1
+                key = (program, label)
+                self.dispatches_by_program[key] = (
+                    self.dispatches_by_program.get(key, 0) + 1
                 )
                 # dispatch records carry cache_hits only when the
                 # adapted-params cache is enabled — a cache-less engine
@@ -145,12 +207,12 @@ class ServingMetrics:
                 # not a 0% hit rate
                 hits = record.get("cache_hits")
                 if isinstance(hits, int):
-                    self.cache_hits_total += hits
+                    self._bump(self.cache_hits_total, label, hits)
                     if isinstance(tenants, int):
-                        self.cache_lookups_total += tenants
+                        self._bump(self.cache_lookups_total, label, tenants)
                 nbytes = record.get("ingest_bytes")
                 if isinstance(nbytes, int):
-                    self.h2d_bytes_total += nbytes
+                    self._bump(self.h2d_bytes_total, label, nbytes)
                 adapt = record.get("adapt_ms")
                 if isinstance(adapt, (int, float)):
                     self.adapt_ms.observe(float(adapt))
@@ -160,13 +222,15 @@ class ServingMetrics:
             elif event == "rollup":
                 retraces = record.get("retraces")
                 if isinstance(retraces, int):
-                    self.retraces_total = retraces
+                    self.retraces_total[label] = retraces
             elif event == "warmup":
-                self.warmups_total += 1
+                self._bump(self.warmups_total, label, 1)
+            elif event == "rollover":
+                self._bump(self.rollovers_total, label, 1)
 
-    def observe_queue_depth(self, depth: int) -> None:
+    def observe_queue_depth(self, depth: int, replica=None) -> None:
         with self._lock:
-            self.queue_depth = int(depth)
+            self.queue_depth[_replica_label(replica)] = int(depth)
 
     def close(self) -> None:  # sink protocol completeness
         pass
@@ -177,48 +241,63 @@ class ServingMetrics:
         """The Prometheus text-format (0.0.4) payload."""
         with self._lock:
             lines: List[str] = []
-
-            def counter(name: str, help_text: str, value: float) -> None:
-                lines.append(f"# HELP {name} {help_text}")
-                lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {_fmt(value)}")
-
-            counter("serving_requests_total",
-                    "Tenants served (cache hits included)",
-                    self.requests_total)
-            lines.append(
-                "# HELP serving_dispatches_total Device dispatches by "
-                "program family"
+            lines += _render_labeled(
+                "serving_requests_total",
+                "Tenants served (cache hits included)",
+                "counter", self.requests_total,
             )
-            lines.append("# TYPE serving_dispatches_total counter")
-            for program in sorted(self.dispatches_by_program):
-                lines.append(
-                    f'serving_dispatches_total{{program="{program}"}} '
-                    f"{self.dispatches_by_program[program]}"
-                )
-            counter("serving_cache_hits_total",
-                    "Adapted-params cache hits (tenants that skipped the "
-                    "inner loop)",
-                    self.cache_hits_total)
-            counter("serving_cache_lookups_total",
-                    "Adapted-params cache lookups (tenants through "
-                    "dispatches)",
-                    self.cache_lookups_total)
-            counter("serving_h2d_bytes_total",
-                    "Actual host-to-device payload bytes uploaded",
-                    self.h2d_bytes_total)
-            counter("serving_retraces_total",
-                    "Mid-run recompiles the strict detector observed "
-                    "(0 in any healthy run)",
-                    self.retraces_total)
-            counter("serving_warmups_total",
-                    "Engine warmups observed", self.warmups_total)
-            lines.append(
-                "# HELP serving_queue_depth Micro-batcher backlog "
-                "(requests queued across shots buckets)"
+            # program x replica labels: merge into one family
+            by_program: Dict[str, int] = {}
+            for (program, label), n in self.dispatches_by_program.items():
+                blob = f'program="{program}"'
+                if label:
+                    blob += f",{label}"
+                by_program[blob] = n
+            lines += _render_labeled(
+                "serving_dispatches_total",
+                "Device dispatches by program family",
+                "counter", by_program, scalar=False,
             )
-            lines.append("# TYPE serving_queue_depth gauge")
-            lines.append(f"serving_queue_depth {self.queue_depth}")
+            lines += _render_labeled(
+                "serving_cache_hits_total",
+                "Adapted-params cache hits (tenants that skipped the "
+                "inner loop)",
+                "counter", self.cache_hits_total,
+            )
+            lines += _render_labeled(
+                "serving_cache_lookups_total",
+                "Adapted-params cache lookups (tenants through "
+                "dispatches)",
+                "counter", self.cache_lookups_total,
+            )
+            lines += _render_labeled(
+                "serving_h2d_bytes_total",
+                "Actual host-to-device payload bytes uploaded",
+                "counter", self.h2d_bytes_total,
+            )
+            lines += _render_labeled(
+                "serving_retraces_total",
+                "Mid-run recompiles the strict detector observed "
+                "(0 in any healthy run)",
+                "counter", self.retraces_total,
+            )
+            lines += _render_labeled(
+                "serving_warmups_total",
+                "Engine warmups observed",
+                "counter", self.warmups_total,
+            )
+            lines += _render_labeled(
+                "serving_rollovers_total",
+                "Checkpoint-rollover engine swaps observed "
+                "(serving/refresh.py)",
+                "counter", self.rollovers_total,
+            )
+            lines += _render_labeled(
+                "serving_queue_depth",
+                "Micro-batcher backlog (requests queued across shots "
+                "buckets)",
+                "gauge", self.queue_depth,
+            )
             lines += self.adapt_ms.render(
                 "serving_adapt_latency_ms",
                 "End-to-end dispatch latency (upload + device + readback)",
@@ -269,6 +348,7 @@ class FanoutSink:
 
 class _Handler(BaseHTTPRequestHandler):
     metrics: ServingMetrics  # set per server class below
+    readiness: Optional[Callable[[], Mapping[str, bool]]] = None
 
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
         if self.path.split("?")[0] in ("/metrics", "/"):
@@ -281,13 +361,38 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif self.path == "/healthz":
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.end_headers()
-            self.wfile.write(b"ok\n")
+            self._healthz()
         else:
             self.send_response(404)
             self.end_headers()
+
+    def _healthz(self) -> None:
+        """Pool readiness: 503 until EVERY replica's warmup completed
+        (per-replica status in the body); the readiness-less single-
+        engine shape keeps the unconditional 200."""
+        if self.readiness is None:
+            code, body = 200, "ok\n"
+        else:
+            try:
+                states = dict(self.readiness())
+            except Exception as e:  # noqa: BLE001 - a probe must answer,
+                # not crash the scrape thread
+                states, e_line = {}, f"readiness probe failed: {e!r}\n"
+                code, body = 503, e_line
+            else:
+                all_ready = bool(states) and all(states.values())
+                code = 200 if all_ready else 503
+                body = ("ok\n" if all_ready else "warming\n") + "".join(
+                    f"replica {rid}: "
+                    f"{'ready' if ok else 'not-ready'}\n"
+                    for rid, ok in sorted(states.items())
+                )
+        payload = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
 
     def log_message(self, fmt, *args):  # silence per-scrape stderr spam
         pass
@@ -296,18 +401,22 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsServer:
     """Background-thread HTTP server exposing ``/metrics`` (+
     ``/healthz``). ``port=0`` binds an ephemeral port — read ``.port``
-    after construction. ``close()`` shuts the server down and joins the
-    thread; the server thread is a daemon either way, so a crashed
-    serving process never hangs on it."""
+    after construction. ``readiness`` (optional; e.g.
+    ``ReplicaSet.readiness``) turns ``/healthz`` into a pool-readiness
+    probe: 503 until every replica reports ready. ``close()`` shuts the
+    server down and joins the thread; the server thread is a daemon
+    either way, so a crashed serving process never hangs on it."""
 
     def __init__(self, metrics: ServingMetrics, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 readiness: Optional[Callable[[], Mapping[str, bool]]] = None):
         self.metrics = metrics
 
         class _BoundHandler(_Handler):
             pass
 
         _BoundHandler.metrics = metrics
+        _BoundHandler.readiness = staticmethod(readiness) if readiness else None
         self._httpd = ThreadingHTTPServer((host, port), _BoundHandler)
         self.host = host
         self.port = int(self._httpd.server_address[1])

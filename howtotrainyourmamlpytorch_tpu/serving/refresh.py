@@ -1,0 +1,235 @@
+"""Artifact prefetch / refresh daemon: zero-downtime checkpoint rollover.
+
+A long-lived serving pool must follow the training run it serves: when
+the experiment writes a new checkpoint, the pool has to move to it
+WITHOUT dropping requests and WITHOUT paying XLA compiles on the hot
+path. The refresh daemon is that lifecycle:
+
+1. **watch** — poll the experiment checkpoint dir
+   (``peek_experiment_state``: the iter is readable without paying a
+   restore; the checkpoint swap itself is atomic, so a mid-write poll
+   sees either the old or the new snapshot, never a torn one) every
+   ``serving_rollover_poll_s``;
+2. **prefetch + pre-warm** — on a new snapshot, restore it READ-ONLY
+   (``load_servable_snapshot``) and, one replica at a time, build a
+   STANDBY engine on that replica's device slice and warm it off the
+   hot path — compile, or (with a pool ``export_root``) deserialize the
+   replica's existing AOT artifacts: the serving programs depend on
+   shapes, never on snapshot values, so the artifact fingerprint is
+   REUSED across rollovers and the standby warms with zero XLA
+   compiles;
+3. **swap** — ``Replica.swap_engine``: a pointer exchange under the
+   replica's dispatch lock. In-flight dispatches complete on the old
+   snapshot, queued requests flow onto the new one — zero dropped
+   requests — and the swap performs zero XLA compiles (the compile-
+   counter delta rides the swap stats and the ``rollover`` telemetry
+   record). Replicas swap one at a time, so the pool never loses more
+   than one replica's worth of standby headroom and always has every
+   replica serving.
+
+The adapted-params cache invalidates for free: its key embeds the
+snapshot content hash, so a genuinely-new checkpoint misses every old
+entry (and an identical re-save keeps them — content, not mtime).
+
+Telemetry: every per-replica swap emits a schema-v11 ``serving`` record
+with ``event='rollover'`` (replica_id, old/new iter markers, standby
+warmup mode/seconds, swap_ms, xla_compiles_at_swap) that ``cli inspect
+summary`` counts.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..config import MAMLConfig
+from .replica import ReplicaSet
+
+
+class RefreshDaemon:
+    """Watch a checkpoint dir and roll the pool onto new snapshots.
+
+    :param pool: the ``ReplicaSet`` to keep fresh.
+    :param cfg: the serving config (geometry for the restore template;
+        ``serving_rollover_poll_s`` is the default poll cadence).
+    :param model_save_dir: the training run's ``saved_models`` dir.
+    :param model_name: checkpoint family name (default ``train_model``).
+    :param model_idx: which checkpoint to follow (default ``latest``).
+    :param poll_s: poll cadence override.
+    :param sink: optional telemetry sink for the ``rollover`` records.
+
+    ``poll_once()`` is the synchronous unit (None when nothing changed,
+    else the per-replica swap stats) — what the tests drive;
+    ``start()``/``stop()`` wrap it in a daemon thread.
+    """
+
+    def __init__(
+        self,
+        pool: ReplicaSet,
+        cfg: MAMLConfig,
+        model_save_dir: str,
+        model_name: str = "train_model",
+        model_idx: str = "latest",
+        poll_s: Optional[float] = None,
+        sink=None,
+    ):
+        self.pool = pool
+        self.cfg = cfg
+        self.model_save_dir = model_save_dir
+        self.model_name = model_name
+        self.model_idx = model_idx
+        self.poll_s = (
+            float(cfg.serving_rollover_poll_s) if poll_s is None
+            else float(poll_s)
+        )
+        if self.poll_s <= 0:
+            raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+        self.sink = sink
+        self.rollovers = 0
+        self.last_error: Optional[BaseException] = None
+        self._served_marker: Optional[int] = None
+        # mid-pool failure bookkeeping: which replicas already swapped
+        # onto the in-progress marker, so the retry after a partial
+        # rollover (replica k's standby warmup failed) resumes at
+        # replica k instead of re-rolling — and double-counting
+        # rollover records for — the ones that already swapped
+        self._pending_marker: Optional[int] = None
+        self._rolled_replicas: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._roll_lock = threading.Lock()
+
+    # -- watch -------------------------------------------------------------
+
+    def current_marker(self) -> Optional[int]:
+        """The checkpoint's identity marker (its ``current_iter``) —
+        readable without a restore; None when absent/corrupt."""
+        from ..experiment.checkpoint import peek_experiment_state
+
+        st = peek_experiment_state(
+            self.model_save_dir, self.model_name, self.model_idx,
+            readonly=True,
+        )
+        if not isinstance(st, dict):
+            return None
+        marker = st.get("current_iter")
+        return int(marker) if isinstance(marker, int) else None
+
+    def prime(self, marker: Optional[int] = None) -> None:
+        """Adopt the currently-served snapshot's marker so the first
+        poll doesn't re-roll onto what the pool already serves. Call
+        after the pool's initial warmup."""
+        self._served_marker = (
+            self.current_marker() if marker is None else marker
+        )
+
+    # -- roll --------------------------------------------------------------
+
+    def poll_once(self) -> Optional[List[Dict[str, Any]]]:
+        """One watch step: roll over iff the checkpoint marker moved.
+        Returns the per-replica swap stats, or None when nothing
+        changed. Transient errors (a checkpoint mid-write on a remote
+        filesystem, a briefly-unreadable dir) are latched on
+        ``last_error`` and retried next poll — the daemon must never
+        kill the serving process it refreshes."""
+        try:
+            marker = self.current_marker()
+            if marker is None or marker == self._served_marker:
+                return None
+            return self._rollover(marker)
+        except Exception as e:  # noqa: BLE001 - refresh is best-effort
+            self.last_error = e
+            print(
+                f"[serving-refresh] rollover attempt failed (will retry "
+                f"next poll): {e!r}",
+                file=sys.stderr,
+                flush=True,
+            )
+            return None
+
+    def _rollover(self, marker: int) -> List[Dict[str, Any]]:
+        from .engine import load_servable_snapshot
+
+        with self._roll_lock:
+            old_marker = self._served_marker
+            if marker != self._pending_marker:
+                # a NEW target marker resets the partial-rollover
+                # bookkeeping (incl. the case where the checkpoint
+                # advanced again mid-retry: every replica re-rolls onto
+                # the newest snapshot)
+                self._pending_marker = marker
+                self._rolled_replicas = set()
+            # READ-ONLY restore; the cache was already pointed at the
+            # experiment's xla_cache by the initial snapshot load (when
+            # the operator enabled it) — don't re-point per rollover
+            state, _ = load_servable_snapshot(
+                self.cfg,
+                self.model_save_dir,
+                self.model_idx,
+                self.model_name,
+                enable_cache=False,
+            )
+            stats: List[Dict[str, Any]] = []
+            for replica in self.pool.replicas:
+                if replica.replica_id in self._rolled_replicas:
+                    continue  # already swapped onto this marker
+                start = time.perf_counter()
+                standby = self.pool.build_standby_engine(
+                    replica.replica_id, state
+                )
+                warm_s = standby.warmup(
+                    artifact_dir=self.pool.artifact_dir_for(
+                        replica.replica_id
+                    )
+                )
+                swap = replica.swap_engine(standby)
+                swap.update(
+                    old_iter=old_marker,
+                    new_iter=marker,
+                    standby_warmup_s=round(warm_s, 3),
+                    standby_warmup_mode=standby.warmup_stats.get("mode"),
+                    rollover_s=round(time.perf_counter() - start, 3),
+                )
+                self._record(swap)
+                stats.append(swap)
+                self._rolled_replicas.add(replica.replica_id)
+            self._served_marker = marker
+            self._pending_marker = None
+            self._rolled_replicas = set()
+            self.rollovers += 1
+            self.last_error = None
+            return stats
+
+    def _record(self, swap: Dict[str, Any]) -> None:
+        if self.sink is None:
+            return
+        from ..telemetry.sinks import make_record
+
+        self.sink.write(
+            make_record("serving", event="rollover", **swap)
+        )
+
+    # -- daemon ------------------------------------------------------------
+
+    def start(self) -> "RefreshDaemon":
+        if self._thread is not None:
+            raise RuntimeError("RefreshDaemon already started")
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.poll_s):
+                self.poll_once()
+
+        self._thread = threading.Thread(
+            target=_run, name="serving-refresh", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None

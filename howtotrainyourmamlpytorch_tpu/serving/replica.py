@@ -1,0 +1,545 @@
+"""Shared-nothing multi-replica serving: one full engine per device slice.
+
+One ``ServingEngine`` owns one device and one process ceiling caps
+``tenants_per_sec``; the pool shape is horizontal: ``ReplicaSet``
+partitions the visible devices into DISJOINT slices
+(``partition_devices``) and runs one complete serving stack per slice —
+own AOT program ladder, own adapted-params LRU, own ``MicroBatcher``
+worker thread, own strict ``RetraceDetector`` — with nothing shared but
+the telemetry sink (records are attributable anyway: every pooled
+engine tags its records with ``replica_id``, schema v11). On CPU/CI the
+replicas come from ``--xla_force_host_platform_device_count`` (the
+``serve-bench --replicas`` path forces it), so the whole pool is
+testable without a TPU.
+
+``Replica`` is the unit the front tier talks to. It PROXIES the engine
+face the ``MicroBatcher`` consumes (``serve_group`` / ``_validate`` /
+``tracer`` / ``max_tenants`` / ``cfg``) and adds the two things the
+engine alone cannot provide:
+
+* **swap atomicity** — ``serve_group`` runs under the replica's swap
+  lock, so ``swap_engine`` (the checkpoint-rollover path,
+  serving/refresh.py) exchanges the engine BETWEEN dispatches: in-flight
+  work completes on the old snapshot, queued requests flow onto the new
+  one, and no request is ever dropped. The standby engine must arrive
+  warmed — the swap itself is a pointer exchange and performs zero XLA
+  compiles (asserted via the process compile counter and reported in
+  the swap stats);
+* **health + circuit-breaking surface** — ``healthy`` folds the
+  engine's dead flag, the batcher worker's liveness and the tripped
+  latch; ``trip`` drains the replica immediately (queued futures fail
+  with the chained root cause — the PR-13 batcher-crash semantics — and
+  the never-warmed/dead engine skips the drain dispatches entirely) so
+  the router can re-home its traffic.
+
+``ReplicaSet`` builds and owns the replicas: per-slice device-pinned
+engines (the engine AOT-compiles against its device's sharding), a
+shared sink, per-replica artifact roots under ``export_root`` (serialized
+executables record their device assignment, so replicas must never load
+each other's artifacts — the per-replica subdir plus the ``device_id``
+manifest key enforce it), and the pool-level ``rollup()`` /
+``readiness()`` the bench line and the ``/healthz`` endpoint report.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..config import MAMLConfig
+from .batcher import MicroBatcher, engine_ready
+from .engine import ServingEngine
+
+
+def partition_devices(devices: Sequence[Any], n_replicas: int) -> List[List[Any]]:
+    """Partition ``devices`` into ``n_replicas`` DISJOINT equal slices
+    (size ``len(devices) // n_replicas``; a non-dividing remainder is
+    left unassigned with the slices still disjoint). Shared-nothing by
+    construction: no device appears in two slices."""
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    if n_replicas > len(devices):
+        raise ValueError(
+            f"cannot run {n_replicas} shared-nothing replicas on "
+            f"{len(devices)} visible device(s) — each replica needs its "
+            "own disjoint slice (on CPU, force more virtual devices via "
+            "--xla_force_host_platform_device_count / serve-bench "
+            "--replicas)"
+        )
+    per = len(devices) // n_replicas
+    return [
+        list(devices[k * per:(k + 1) * per]) for k in range(n_replicas)
+    ]
+
+
+class _ReplicaMetricsAdapter:
+    """Binds a ``replica_id`` onto the batcher's queue-depth gauge
+    reports so the shared ``ServingMetrics`` registry keeps one
+    per-replica series (the batcher itself stays replica-agnostic)."""
+
+    def __init__(self, metrics, replica_id: int):
+        self._metrics = metrics
+        self._replica_id = replica_id
+
+    def observe_queue_depth(self, depth: int) -> None:
+        self._metrics.observe_queue_depth(depth, replica=self._replica_id)
+
+
+class Replica:
+    """One shared-nothing serving replica: engine + micro-batcher +
+    swap lock + health latch. Implements the engine face the
+    ``MicroBatcher`` consumes, so the batcher dispatches through the
+    replica (and therefore under the swap lock) without modification."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        devices: Sequence[Any],
+        engine: ServingEngine,
+        max_wait_ms: Optional[float] = None,
+        metrics=None,
+    ):
+        import threading
+
+        self.replica_id = int(replica_id)
+        self.devices = list(devices)
+        self.engine = engine
+        self._swap_lock = threading.Lock()
+        self._trip_lock = threading.Lock()
+        self._tripped = False
+        self._trip_cause: Optional[BaseException] = None
+        self._closed = False
+        batcher_metrics = (
+            _ReplicaMetricsAdapter(metrics, self.replica_id)
+            if metrics is not None else None
+        )
+        self.batcher = MicroBatcher(
+            self, max_wait_ms=max_wait_ms, metrics=batcher_metrics
+        )
+
+    # -- the engine face the MicroBatcher consumes -------------------------
+
+    @property
+    def cfg(self) -> MAMLConfig:
+        return self.engine.cfg
+
+    @property
+    def tracer(self):
+        return self.engine.tracer
+
+    @property
+    def max_tenants(self) -> int:
+        return self.engine.max_tenants
+
+    @property
+    def warmup_stats(self) -> Dict[str, Any]:
+        return self.engine.warmup_stats
+
+    @property
+    def _dead(self) -> Optional[BaseException]:
+        return self.engine._dead
+
+    @property
+    def _tenants_served(self) -> int:
+        # proxied for engine_ready's lazily-served-engine drain gate
+        return self.engine._tenants_served
+
+    def _validate(self, req) -> int:
+        return self.engine._validate(req)
+
+    def serve_group(self, requests, queue_ms: float = 0.0):
+        # the swap lock is what makes checkpoint rollover dispatch-atomic:
+        # swap_engine waits out an in-flight dispatch, and the next
+        # dispatch reads the fresh engine reference
+        with self._swap_lock:
+            return self.engine.serve_group(requests, queue_ms=queue_ms)
+
+    # -- front-tier surface ------------------------------------------------
+
+    def submit(self, request):
+        """Enqueue one request into this replica's micro-batcher."""
+        if self._tripped:
+            raise RuntimeError(
+                f"replica {self.replica_id} is circuit-broken "
+                "(root cause chained below)"
+            ) from self._trip_cause
+        return self.batcher.submit(request)
+
+    def queue_depth(self) -> int:
+        return self.batcher.queue_depth()
+
+    @property
+    def ready(self) -> bool:
+        """Warmup completed and the replica can take traffic."""
+        return not self._tripped and engine_ready(self.engine)
+
+    @property
+    def healthy(self) -> bool:
+        """Fit for routing NOW: not tripped, engine alive + warmed,
+        batcher worker running. The router skips unhealthy replicas;
+        it only TRIPS the ``broken`` subset."""
+        return (
+            not self._tripped
+            and not self._closed
+            and engine_ready(self.engine)
+            and self.batcher.worker_alive
+        )
+
+    @property
+    def broken(self) -> bool:
+        """Irrecoverably unfit: engine dead, batcher worker dead, or
+        closed — what the router's health sweep TRIPS (drains + fails
+        the backlog). Deliberately NARROWER than ``not healthy``: a
+        merely not-yet-warmed replica (pool warmup still running, or a
+        lazily-compiling deployment) is skipped by routing but must
+        never be destructively tripped — it becomes healthy the moment
+        its warmup completes."""
+        return (
+            self._closed
+            or self.engine._dead is not None
+            or not self.batcher.worker_alive
+        )
+
+    @property
+    def tripped(self) -> bool:
+        return self._tripped
+
+    @property
+    def trip_cause(self) -> Optional[BaseException]:
+        return self._trip_cause
+
+    def trip(self, cause: Optional[BaseException] = None) -> bool:
+        """Circuit-break this replica: fail every queued future with the
+        chained root cause and shut the batcher down WITHOUT the drain
+        dispatches (a dead/never-warmed engine cannot serve them — the
+        immediate-shutdown path the batcher close fix added).
+        Idempotent; returns True only for the call that actually
+        transitioned (latched under a lock, so two concurrent sweeps
+        can never both claim — or double-count — one trip)."""
+        with self._trip_lock:
+            if self._tripped:
+                return False
+            self._tripped = True
+            self._trip_cause = (
+                cause if cause is not None else self.engine._dead
+            )
+        err = RuntimeError(
+            f"replica {self.replica_id} circuit-broken: traffic re-homed "
+            "(root cause chained below)"
+        )
+        err.__cause__ = self._trip_cause
+        # fail the backlog FIRST with the named cause, then stop the
+        # worker on the no-drain path — a dead worker's join is immediate
+        self.batcher._fail_pending(err)
+        self.batcher.close(drain=False)
+        return True
+
+    # -- rollover ----------------------------------------------------------
+
+    def swap_engine(self, standby: ServingEngine) -> Dict[str, Any]:
+        """Atomically swap the served engine for a WARMED standby.
+
+        Zero dropped requests by construction (queued requests simply
+        dispatch on the new engine; an in-flight dispatch completes on
+        the old one first — the swap lock serializes) and zero XLA
+        compiles at swap time (the standby compiled/deserialized during
+        ITS warmup, off the hot path; the returned stats carry the
+        process compile-counter delta across the swap as proof).
+        """
+        from . import export as export_lib
+
+        if not standby.warmup_stats:
+            raise ValueError(
+                "standby engine must complete warmup() before the swap — "
+                "swapping a cold engine would pay its whole compile bill "
+                "on the first live request"
+            )
+        compiles0 = export_lib.xla_compile_count()
+        start = time.perf_counter()
+        with self._swap_lock:
+            old = self.engine
+            # the rollup describes the REPLICA's serving history: carry
+            # the retired engine's counters/latency windows/span into
+            # the standby so a mid-load rollover doesn't silently drop
+            # every pre-swap dispatch from the pool rollup (both
+            # engines are quiescent under the lock)
+            standby.adopt_serving_history(old)
+            self.engine = standby
+        swap_ms = (time.perf_counter() - start) * 1e3
+        return {
+            "replica_id": self.replica_id,
+            "swap_ms": round(swap_ms, 3),
+            "xla_compiles_at_swap": (
+                export_lib.xla_compile_count() - compiles0
+            ),
+            "old_snapshot_dead": old._dead is not None,
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if not self._tripped:
+            self.batcher.close()
+
+
+class ReplicaSet:
+    """The shared-nothing replica pool: N device-pinned serving stacks.
+
+    :param cfg: the serving config; ``serving_replicas`` is the default
+        pool width (overridable via ``n_replicas``).
+    :param state: the servable snapshot every replica starts from (each
+        engine takes its own private on-device copy).
+    :param n_replicas: pool width override.
+    :param devices: the device list to partition (default:
+        ``jax.devices()``).
+    :param sink: ONE telemetry sink shared by every replica — records
+        are per-replica attributable via their ``replica_id`` field.
+    :param metrics: optional ``ServingMetrics`` registry; queue-depth
+        gauges are reported per replica through a bound adapter. (Tee it
+        into ``sink`` with ``FanoutSink`` so counters aggregate too —
+        the serve-bench wiring.)
+    :param export_root: optional AOT-artifact root. Each replica keeps
+        its own subdirectory (``replica<k>/``) because serialized
+        executables record their device assignment — warmup saves on the
+        first cold start and every later warmup (including rollover
+        standbys, which reuse the artifact fingerprint: the programs
+        depend on shapes, never on snapshot values) deserializes with
+        zero XLA compiles.
+
+    Remaining keyword args mirror the ``ServingEngine`` ctor and are
+    applied to every replica's engine (and to rollover standbys).
+    """
+
+    def __init__(
+        self,
+        cfg: MAMLConfig,
+        state,
+        n_replicas: Optional[int] = None,
+        devices: Optional[Sequence[Any]] = None,
+        shots_buckets: Optional[Sequence[int]] = None,
+        sink=None,
+        strict_retrace: bool = True,
+        ingest: Optional[str] = None,
+        store=None,
+        cache_size: Optional[int] = None,
+        snapshot_id: Optional[str] = None,
+        tracer=None,
+        metrics=None,
+        export_root: Optional[str] = None,
+        max_wait_ms: Optional[float] = None,
+    ):
+        import jax
+
+        self.cfg = cfg
+        self.n_replicas = (
+            cfg.serving_replicas if n_replicas is None else int(n_replicas)
+        )
+        devices = list(jax.devices()) if devices is None else list(devices)
+        self.slices = partition_devices(devices, self.n_replicas)
+        if len(devices) > self.n_replicas:
+            import warnings
+
+            # the serving programs are single-device: each replica's
+            # engine serves from its slice's LEAD device only, so every
+            # device beyond one-per-replica (wider slices AND the
+            # non-dividing remainder) is idle — be loud about it
+            # instead of silently using n_replicas/len(devices) of the
+            # machine
+            warnings.warn(
+                f"ReplicaSet: {self.n_replicas} replica(s) over "
+                f"{len(devices)} devices leaves "
+                f"{len(devices) - self.n_replicas} device(s) idle (the "
+                "serving engine is single-device; one replica per "
+                "device is the full-utilization shape — raise "
+                "n_replicas/serving_replicas to the device count)",
+                stacklevel=2,
+            )
+        self.sink = sink
+        self.metrics = metrics
+        self.export_root = export_root or None
+        self._engine_kwargs: Dict[str, Any] = dict(
+            shots_buckets=shots_buckets,
+            sink=sink,
+            strict_retrace=strict_retrace,
+            ingest=ingest,
+            store=store,
+            cache_size=cache_size,
+            tracer=tracer,
+        )
+        self.replicas: List[Replica] = [
+            Replica(
+                k,
+                self.slices[k],
+                self._build_engine(k, state, snapshot_id),
+                max_wait_ms=max_wait_ms,
+                metrics=metrics,
+            )
+            for k in range(self.n_replicas)
+        ]
+
+    def _build_engine(
+        self, replica_id: int, state, snapshot_id: Optional[str]
+    ) -> ServingEngine:
+        return ServingEngine(
+            self.cfg,
+            state,
+            snapshot_id=snapshot_id,
+            device=self.slices[replica_id][0],
+            replica_id=replica_id,
+            **self._engine_kwargs,
+        )
+
+    def artifact_dir_for(self, replica_id: int) -> Optional[str]:
+        """This replica's private AOT-artifact root (None when the pool
+        has no export root). Per-replica because the serialized
+        executables are device-pinned."""
+        if self.export_root is None:
+            return None
+        return os.path.join(self.export_root, f"replica{replica_id}")
+
+    def warmup(self) -> float:
+        """Warm every replica (serially — compile determinism and one
+        readable compile-counter stream); returns total wall seconds."""
+        start = time.perf_counter()
+        for r in self.replicas:
+            r.engine.warmup(
+                artifact_dir=self.artifact_dir_for(r.replica_id)
+            )
+        return time.perf_counter() - start
+
+    # -- standby / recovery ------------------------------------------------
+
+    def build_standby_engine(
+        self, replica_id: int, state, snapshot_id: Optional[str] = None
+    ) -> ServingEngine:
+        """A fresh engine for ``replica_id``'s device slice over a NEW
+        snapshot — the rollover standby slot (serving/refresh.py). The
+        caller warms it (off the hot path) and then
+        ``Replica.swap_engine``s it in."""
+        return self._build_engine(replica_id, state, snapshot_id)
+
+    def restart_replica(
+        self, replica_id: int, state, snapshot_id: Optional[str] = None
+    ) -> Replica:
+        """Replace a (typically circuit-broken) replica with a fresh
+        engine + batcher over ``state``; the new replica is warmed and
+        immediately routable (the recover half of
+        circuit-break -> re-home -> recover)."""
+        old = self.replicas[replica_id]
+        if not old.tripped:
+            old.close()
+        engine = self._build_engine(replica_id, state, snapshot_id)
+        engine.warmup(artifact_dir=self.artifact_dir_for(replica_id))
+        fresh = Replica(
+            replica_id,
+            self.slices[replica_id],
+            engine,
+            max_wait_ms=self.replicas[replica_id].batcher.max_wait_ms,
+            metrics=self.metrics,
+        )
+        self.replicas[replica_id] = fresh
+        return fresh
+
+    # -- pool surfaces -----------------------------------------------------
+
+    def readiness(self) -> Dict[str, bool]:
+        """Per-replica readiness — the ``/healthz`` payload (the
+        endpoint reports 503 until every value is True)."""
+        return {str(r.replica_id): r.ready for r in self.replicas}
+
+    def rollup(self) -> Dict[str, Any]:
+        """Per-replica rollups (each emits its own telemetry rollup
+        record, ``replica_id``-tagged) plus the pool aggregate:
+        ``tenants_per_sec`` over the UNION wall-clock span (first
+        dispatch start anywhere to last dispatch end anywhere — the
+        honest aggregate: per-replica rates must not be summed, their
+        spans overlap) and ``cache_hit_rate`` as pool hits over pool
+        lookups."""
+        import numpy as np
+
+        per = []
+        starts, ends = [], []
+        adapt_samples: List[float] = []
+        queue_samples: List[float] = []
+        h2d_samples: List[float] = []
+        batch_samples: List[float] = []
+        dispatch_samples: List[float] = []
+        sync_samples: List[float] = []
+        tenants = dispatches = retraces = hits = lookups = 0
+        any_cache = False
+        for r in self.replicas:
+            eng = r.engine
+            ru = dict(eng.rollup())
+            ru["replica_id"] = r.replica_id
+            per.append(ru)
+            tenants += eng._tenants_served
+            dispatches += ru["dispatches"]
+            retraces += ru["retraces"]
+            adapt_samples.extend(eng._adapt_ms)
+            queue_samples.extend(eng._queue_ms)
+            h2d_samples.extend(eng._h2d_bytes)
+            batch_samples.extend(eng._batch_ms)
+            dispatch_samples.extend(eng._dispatch_ms)
+            sync_samples.extend(eng._sync_ms)
+            if eng.cache_size > 0:
+                any_cache = True
+                hits += eng.cache_hits
+                lookups += eng.cache_hits + eng.cache_misses
+            if eng._span_start is not None and eng._span_end is not None:
+                starts.append(eng._span_start)
+                ends.append(eng._span_end)
+        span_s = (max(ends) - min(starts)) if starts else 0.0
+        adapt = np.asarray(adapt_samples, np.float64)
+        queue = np.asarray(queue_samples, np.float64)
+        h2d = np.asarray(h2d_samples, np.float64)
+        batch = np.asarray(batch_samples, np.float64)
+        disp = np.asarray(dispatch_samples, np.float64)
+        syncs = np.asarray(sync_samples, np.float64)
+        return {
+            "replicas": self.n_replicas,
+            "per_replica": per,
+            "tenants": tenants,
+            "dispatches": dispatches,
+            "retraces": retraces,
+            # pooled latency: percentiles over the MERGED per-dispatch
+            # samples (each replica contributes its window)
+            "adapt_ms_p50": (
+                round(float(np.percentile(adapt, 50)), 3) if adapt.size
+                else None
+            ),
+            "adapt_ms_p95": (
+                round(float(np.percentile(adapt, 95)), 3) if adapt.size
+                else None
+            ),
+            "queue_ms_p50": (
+                round(float(np.percentile(queue, 50)), 3) if queue.size
+                else None
+            ),
+            "batch_ms_mean": (
+                round(float(np.mean(batch)), 3) if batch.size else None
+            ),
+            "dispatch_ms_p50": (
+                round(float(np.percentile(disp, 50)), 3) if disp.size
+                else None
+            ),
+            "sync_ms_p50": (
+                round(float(np.percentile(syncs, 50)), 3) if syncs.size
+                else None
+            ),
+            "ingest": self.replicas[0].engine.ingest,
+            "h2d_bytes_per_dispatch": (
+                round(float(np.mean(h2d)), 1) if h2d.size else None
+            ),
+            "tenants_per_sec": (
+                round(tenants / span_s, 3) if span_s > 0 else None
+            ),
+            "cache_hit_rate": (
+                round(hits / lookups, 4) if any_cache and lookups else None
+            ),
+        }
+
+    def close(self) -> None:
+        for r in self.replicas:
+            r.close()

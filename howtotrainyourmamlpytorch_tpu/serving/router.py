@@ -1,0 +1,243 @@
+"""Cache-affinity front tier for the shared-nothing replica pool.
+
+Scaling the serving path N ways must not dilute the adapted-params
+cache N ways: a repeat tenant only hits if it lands on the replica that
+adapted it last time. The router therefore routes by **cache
+affinity** — a stable fingerprint of the support-set content, the
+content core of the engine's adapted-params cache key (its shots and
+snapshot-salt suffixes are deliberately excluded: same-support tenants
+co-locate regardless of shots, and a checkpoint rollover changes cache
+keys without reshuffling homes) picks each request's HOME replica. The fingerprint is SHA-1-based and therefore
+stable across process restarts and machines (never the builtin
+``hash()``, whose per-process seed would reshuffle every tenant on
+every restart and cold the whole pool).
+
+Two pressure valves sit on top of pure affinity:
+
+* **queue-depth spillover** — when the home replica's micro-batcher
+  backlog reaches ``serving_router_spill_depth``, the request goes to
+  the least-loaded healthy replica instead: a cold adapt there beats
+  queueing behind a saturated home (the miss re-populates that
+  replica's cache, so a persistently hot tenant converges to wherever
+  it keeps landing);
+* **circuit breaking** — every submit sweeps replica health (engine
+  dead flag, batcher worker liveness — the signals the existing
+  watchdog/health surfaces set). A replica that turns BROKEN is
+  TRIPPED: its queued futures fail immediately with the chained root
+  cause (the PR-13 batcher-crash semantics, skipping the drain
+  dispatches a broken engine cannot serve) and its traffic is
+  re-homed deterministically to the next healthy replica on the ring
+  (a merely not-yet-warmed replica is skipped by routing, never
+  tripped — it becomes routable when its warmup completes) —
+  so every live request sees at most one failure and every new request
+  sees none. A replacement replica (``ReplicaSet.restart_replica``)
+  is picked up automatically: the router reads the pool's live replica
+  list on every submit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class AllReplicasUnhealthyError(RuntimeError):
+    """Every replica in the pool is circuit-broken/dead — there is
+    nowhere to route. Carries the per-replica causes."""
+
+    def __init__(self, causes: Dict[int, Optional[BaseException]]):
+        self.causes = causes
+        detail = "; ".join(
+            f"replica {rid}: {cause!r}" for rid, cause in causes.items()
+        )
+        super().__init__(
+            f"no healthy replica to route to ({detail or 'empty pool'})"
+        )
+
+
+def request_fingerprint(request) -> str:
+    """Stable content fingerprint of a request's ADAPTATION identity:
+    the support-set CONTENT — the content core of the engine's
+    adapted-params cache key, deliberately minus its two suffixes: the
+    engine-local snapshot salt (homes must survive a checkpoint
+    rollover; cache entries must not) and the shots count (same-support
+    tenants co-locate regardless of shots, which can only help
+    locality; a shots change still misses the cache on its home, same
+    as anywhere).
+
+    SHA-1 over the raw bytes: two processes (or two restarts of one)
+    always agree, which is what keeps LRU hit rates intact across
+    restarts of the front tier. The content recipe is
+    ``batcher.update_support_digest`` — the SAME function the engine's
+    ``_cache_key`` consumes, so the affinity identity can never
+    silently drift from the cache identity.
+    """
+    from .batcher import update_support_digest
+
+    h = hashlib.sha1()
+    update_support_digest(h, request)
+    return h.hexdigest()
+
+
+def home_replica(fingerprint: str, n_replicas: int) -> int:
+    """The fingerprint's home replica: the leading 64 fingerprint bits
+    mod the pool width. Pure arithmetic on the stable fingerprint —
+    restart-invariant by construction."""
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    return int(fingerprint[:16], 16) % n_replicas
+
+
+class ReplicaRouter:
+    """Route ``submit()`` traffic over a ``ReplicaSet`` (or a plain
+    replica list) by cache affinity with spillover + circuit breaking.
+
+    :param pool: a ``serving.replica.ReplicaSet`` (live view — replicas
+        replaced via ``restart_replica`` are picked up automatically) or
+        a fixed replica list.
+    :param spill_depth: home-replica backlog at which the request
+        spills to the least-loaded healthy replica (default: the
+        config's ``serving_router_spill_depth``).
+    """
+
+    def __init__(self, pool, spill_depth: Optional[int] = None):
+        self._pool = pool
+        if spill_depth is None:
+            cfg = getattr(pool, "cfg", None)
+            spill_depth = (
+                cfg.serving_router_spill_depth if cfg is not None else 8
+            )
+        if spill_depth < 1:
+            raise ValueError(
+                f"spill_depth must be >= 1, got {spill_depth}"
+            )
+        self.spill_depth = int(spill_depth)
+        self._lock = threading.Lock()
+        # routing decision counters (the bench/inspect surface)
+        self.routed_total = 0
+        self.routed_affinity = 0
+        self.routed_spill = 0
+        self.routed_rehomed = 0
+        self.trips = 0
+        self.routed_by_replica: Dict[int, int] = {}
+
+    @property
+    def replicas(self) -> List[Any]:
+        return list(getattr(self._pool, "replicas", self._pool))
+
+    # -- health ------------------------------------------------------------
+
+    def _sweep_health(self, replicas: List[Any]) -> None:
+        """Trip (drain + latch) every replica that turned BROKEN
+        (engine dead, worker dead, closed) — its queued futures fail
+        NOW with the chained cause instead of hanging until a timeout.
+        A merely not-yet-warmed replica is unhealthy-for-routing but
+        NOT broken: it is skipped, never destructively tripped (it
+        becomes routable the moment its warmup completes)."""
+        for r in replicas:
+            if getattr(r, "broken", not r.healthy) and not r.tripped:
+                # trip() returns True only for the call that actually
+                # transitioned (Replica latches it under a lock), so
+                # two concurrent sweeps can never double-count one trip
+                if r.trip():
+                    with self._lock:
+                        self.trips += 1
+
+    # -- routing -----------------------------------------------------------
+
+    def _decide(self, request):
+        """The routing decision: returns ``(target, kind)`` with kind
+        in ``('affinity', 'spill', 'rehomed')`` — no stats recorded."""
+        replicas = self.replicas
+        n = len(replicas)
+        if n == 0:
+            raise AllReplicasUnhealthyError({})
+        self._sweep_health(replicas)
+        home_id = home_replica(request_fingerprint(request), n)
+        # deterministic ring walk from the home: a broken home re-homes
+        # to the SAME fallback for every request (and every router
+        # process), preserving what cache locality can be preserved
+        home = None
+        for off in range(n):
+            cand = replicas[(home_id + off) % n]
+            if cand.healthy:
+                home = cand
+                break
+        if home is None:
+            raise AllReplicasUnhealthyError(
+                {r.replica_id: r.trip_cause for r in replicas}
+            )
+        rehomed = home.replica_id != replicas[home_id].replica_id
+        target, spilled = home, False
+        if home.queue_depth() >= self.spill_depth:
+            healthy = [r for r in replicas if r.healthy]
+            least = min(healthy, key=lambda r: r.queue_depth())
+            if (
+                least.replica_id != home.replica_id
+                and least.queue_depth() < home.queue_depth()
+            ):
+                target, spilled = least, True
+        kind = "spill" if spilled else ("rehomed" if rehomed else
+                                        "affinity")
+        return target, kind
+
+    def _record_route(self, target, kind: str) -> None:
+        with self._lock:
+            self.routed_total += 1
+            if kind == "spill":
+                self.routed_spill += 1
+            elif kind == "rehomed":
+                self.routed_rehomed += 1
+            else:
+                self.routed_affinity += 1
+            self.routed_by_replica[target.replica_id] = (
+                self.routed_by_replica.get(target.replica_id, 0) + 1
+            )
+
+    def route(self, request) -> Any:
+        """The routing decision only (no submit): returns the target
+        replica and records it in the stats. Split out so tests can
+        assert placement without dispatching."""
+        target, kind = self._decide(request)
+        self._record_route(target, kind)
+        return target
+
+    def submit(self, request):
+        """Route one request and enqueue it on the chosen replica's
+        micro-batcher; returns the replica's pending future.
+
+        The decision and Replica.submit() are two steps, so another
+        thread's health sweep can trip the chosen replica in between;
+        that race re-routes (the next decision sees the trip and walks
+        the ring) instead of surfacing a circuit-broken error for a
+        request that never had a healthy-home failure — bounded by the
+        pool width, since each retry consumes one tripped replica. The
+        stats record only the decision that actually ENQUEUED, so
+        ``routed_total`` always equals requests accepted (retried
+        failed attempts are not double-counted)."""
+        for _ in range(len(self.replicas) + 1):
+            target, kind = self._decide(request)
+            try:
+                pending = target.submit(request)
+            except RuntimeError:
+                if target.healthy:
+                    raise  # a real submit error, not the trip race
+                continue
+            self._record_route(target, kind)
+            return pending
+        raise AllReplicasUnhealthyError(
+            {r.replica_id: r.trip_cause for r in self.replicas}
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "routed_total": self.routed_total,
+                "routed_affinity": self.routed_affinity,
+                "routed_spill": self.routed_spill,
+                "routed_rehomed": self.routed_rehomed,
+                "trips": self.trips,
+                "routed_by_replica": dict(self.routed_by_replica),
+                "spill_depth": self.spill_depth,
+            }

@@ -70,7 +70,7 @@ Record kinds:
   ``episode_cursor`` re-entry point) — so a pod-scale preemption or a
   topology-changing resume documents itself in the run's own log;
 * ``serving``        — the adapt-on-request serving engine (serving/,
-  schema v8; extended in v9): ``event`` names the record shape —
+  schema v8; extended in v9/v10/v11): ``event`` names the record shape —
   ``dispatch`` (one multi-tenant serving dispatch: real ``tenants``,
   the padded ``bucket`` and ``shots`` point it rode, host ``queue_ms``
   in the micro-batcher and end-to-end ``adapt_ms`` device latency;
@@ -79,12 +79,20 @@ Record kinds:
   — the dispatch's actual H2D payload — and ``cache_hits``), ``warmup``
   (since v9: how the engine warmed — ``mode`` 'artifacts' (AOT
   export deserialize) or 'compile', ``warmup_ms``, ``xla_compiles`` —
-  0 on the artifact path — and ``programs``) or ``rollup`` (the run
+  0 on the artifact path — and ``programs``), ``rollup`` (the run
   condensed: dispatch/tenant counts, ``adapt_ms_p50`` /
   ``adapt_ms_p95``, ``tenants_per_sec``, the strict retrace count — 0
   in any healthy run — and since v9 ``h2d_bytes_per_dispatch`` and
-  ``cache_hit_rate``). The ``serving:`` line of ``cli inspect
-  summary`` renders these jax-free;
+  ``cache_hit_rate``) or, since v11, ``rollover`` (one replica's
+  zero-downtime checkpoint-rollover swap, serving/refresh.py:
+  ``replica_id``, ``old_iter`` / ``new_iter``, the standby's warmup
+  mode/seconds, ``swap_ms`` and ``xla_compiles_at_swap`` — 0 in any
+  healthy rollover). Since v11 every record a POOLED engine emits
+  (serving/replica.py) additionally carries its ``replica_id``, so a
+  multi-replica pool's merged stream stays per-replica attributable;
+  single-engine records simply omit the field. The ``serving:`` line
+  of ``cli inspect summary`` renders these jax-free, with a
+  per-replica breakdown when replica ids are present;
 * ``analysis``       — the build-time program audit ran
   (``analysis_level != 'off'``): how many programs were audited (incl.
   the SPMD family on multi-device builds), how many contract violations
@@ -184,6 +192,17 @@ Version history / migration notes:
   unchanged (``tests/fixtures/telemetry_v9_schema.jsonl`` pins a
   v9-era log) and the forward-compat rules carry over (the
   future-schema fixture is re-pinned at v11-unknown).
+* **v11** — the multi-replica serving pool (serving/replica.py /
+  router.py / refresh.py): ``serving`` records emitted by a pooled
+  engine carry an optional ``replica_id``, and a new
+  ``event='rollover'`` shape records each replica's zero-downtime
+  checkpoint swap (``old_iter`` / ``new_iter``, standby warmup
+  mode/seconds, ``swap_ms``, ``xla_compiles_at_swap``). Pure
+  addition — no new kinds, no new REQUIRED fields (``serving`` still
+  requires only ``event``): every v1..v10 record validates unchanged
+  (``tests/fixtures/telemetry_v10_schema.jsonl`` pins a v10-era log)
+  and the forward-compat rules carry over (the future-schema fixture
+  is re-pinned at v12-unknown).
 """
 
 from __future__ import annotations
@@ -191,7 +210,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 

@@ -157,6 +157,9 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
     """Condense the ``serving`` records (schema v8): dispatch/tenant
     counts and adapt-latency p50/p95 recomputed from the per-dispatch
     records, plus the LAST rollup record's tenants_per_sec / retraces.
+    Since v11 also the multi-replica grain: a per-``replica_id``
+    breakdown (records without the field — every pre-v11 log — simply
+    produce no per-replica rows) and the checkpoint-rollover count.
     None when the run has no serving records at all (every pre-v8 log),
     so the summary line simply doesn't render — old logs never crash."""
     sv = [r for r in records if r.get("kind") == "serving"]
@@ -229,9 +232,60 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
                 if g["hits"] and tenants_total else None
             ),
         }
+    # per-replica breakdown (schema v11, serving/replica.py): dispatch/
+    # tenant counts, latency p50 and cache-hit rate per replica_id —
+    # how evenly the affinity router spread the pool's traffic. Records
+    # without a replica_id (single-engine runs, pre-v11 logs) yield no
+    # rows; malformed ids are skipped, never a crash.
+    per_replica: Dict[str, Dict[str, Any]] = {}
+    rgroups: Dict[int, Dict[str, list]] = {}
+    for r in sv:
+        if r.get("event") != "dispatch":
+            continue
+        rid = r.get("replica_id")
+        if not isinstance(rid, int) or isinstance(rid, bool):
+            continue
+        g = rgroups.setdefault(rid, {"adapt": [], "tenants": [], "hits": []})
+        adapt_v = r.get("adapt_ms")
+        if isinstance(adapt_v, (int, float)) and not isinstance(
+            adapt_v, bool
+        ) and math.isfinite(adapt_v):
+            g["adapt"].append(float(adapt_v))
+        n_tenants = r.get("tenants")
+        if isinstance(n_tenants, int) and not isinstance(n_tenants, bool):
+            g["tenants"].append(n_tenants)
+        hits = r.get("cache_hits")
+        if isinstance(hits, int) and not isinstance(hits, bool):
+            g["hits"].append(hits)
+    for rid in sorted(rgroups):
+        g = rgroups[rid]
+        tenants_total = sum(g["tenants"])
+        per_replica[str(rid)] = {
+            "dispatches": len(g["tenants"]) or len(g["adapt"]),
+            "tenants": tenants_total,
+            "adapt_ms_p50": (
+                round(_percentile(g["adapt"], 50), 3) if g["adapt"]
+                else None
+            ),
+            "cache_hit_rate": (
+                round(sum(g["hits"]) / tenants_total, 4)
+                if g["hits"] and tenants_total else None
+            ),
+        }
+    # one pool rollover emits ONE record per replica swap: count
+    # distinct target markers so the summary agrees with
+    # RefreshDaemon.rollovers and the bench line's rollover block
+    # (records without a new_iter degrade to one group)
+    roll_recs = [r for r in sv if r.get("event") == "rollover"]
     out: Dict[str, Any] = {
         "dispatches": sum(1 for r in sv if r.get("event") == "dispatch"),
         "tenants": sum(tenants),
+        # v11 pool fields (0 / {} on single-engine and pre-v11 logs)
+        "rollovers": (
+            len({r.get("new_iter") for r in roll_recs}) if roll_recs
+            else 0
+        ),
+        "per_replica": per_replica,
         "tenants_per_dispatch_mean": (
             round(sum(tenants) / len(tenants), 3) if tenants else None
         ),
@@ -513,9 +567,26 @@ def cmd_summary(args) -> int:
             parts.append(f"{sv['h2d_bytes_per_dispatch']:.0f} B/dispatch")
         if sv.get("cache_hit_rate") is not None:
             parts.append(f"cache hit {sv['cache_hit_rate']:.0%}")
+        if sv.get("per_replica"):
+            parts.append(f"{len(sv['per_replica'])} replica(s)")
+        if sv.get("rollovers"):
+            parts.append(f"{sv['rollovers']} rollover(s)")
         if sv.get("retraces"):
             parts.append(f"{sv['retraces']} RETRACE(S)")
         lines.append("  serving: " + ", ".join(parts))
+        # the per-replica grain (schema v11, multi-replica pools): how
+        # evenly the affinity router spread traffic + per-replica cache
+        # locality; absent on single-engine and pre-v11 logs
+        for rid, row in (sv.get("per_replica") or {}).items():
+            sub = [
+                f"{row['dispatches']} dispatch(es)",
+                f"{row['tenants']} tenant(s)",
+            ]
+            if row.get("adapt_ms_p50") is not None:
+                sub.append(f"p50 {row['adapt_ms_p50']:.2f}ms")
+            if row.get("cache_hit_rate") is not None:
+                sub.append(f"cache hit {row['cache_hit_rate']:.0%}")
+            lines.append(f"    serving[replica {rid}]: " + ", ".join(sub))
         # the per-(program, bucket, shots) grain: one line per compiled
         # dispatch signature — where the aggregate p50 actually comes from
         for key, row in (sv.get("per_bucket") or {}).items():

@@ -30,8 +30,15 @@ from howtotrainyourmamlpytorch_tpu.core import maml
 from howtotrainyourmamlpytorch_tpu.serving import (
     AdaptRequest,
     MicroBatcher,
+    RefreshDaemon,
+    Replica,
+    ReplicaRouter,
+    ReplicaSet,
     ServingEngine,
+    home_replica,
     load_servable_snapshot,
+    partition_devices,
+    request_fingerprint,
     serve_requests,
 )
 from howtotrainyourmamlpytorch_tpu.serving.batcher import group_requests
@@ -1505,3 +1512,710 @@ def test_engine_polls_ondemand_profiler_per_dispatch(cfg, engine, tmp_path):
     finally:
         engine.profiler = None
     assert [c[0] for c in fake.calls] == ["start", "stop"]
+
+
+# -- schema v11: multi-replica pool, cache-affinity router, rollover ---------
+
+
+@pytest.fixture(scope="module")
+def pool_cfg():
+    """The pool protocol's config: same geometry as `cfg` (so `state`
+    is reusable), smaller program ladder (the pool compiles it once PER
+    REPLICA)."""
+    return make_serving_cfg(
+        serving_bucket_ladder=[1, 2], serving_max_tenants_per_dispatch=2
+    )
+
+
+@pytest.fixture(scope="module")
+def pool(pool_cfg, state):
+    """A warmed 2-replica shared-nothing pool over the module snapshot
+    (the conftest forces 8 virtual CPU devices, so each replica owns a
+    disjoint 4-device slice). Cache ON: the affinity tests need the
+    adapted-params LRU live."""
+    ps = ReplicaSet(
+        pool_cfg, state, n_replicas=2, devices=jax.devices()[:2],
+        shots_buckets=(1,), sink=_ListSink(), strict_retrace=True,
+        cache_size=32,
+    )
+    ps.warmup()
+    yield ps
+    ps.close()
+
+
+@pytest.fixture(scope="module")
+def single_engine(pool_cfg, state):
+    """The single-engine comparator for the pool bit-exactness contract:
+    same config, same snapshot, no pool."""
+    eng = ServingEngine(
+        pool_cfg, state, shots_buckets=(1,), strict_retrace=True,
+    )
+    eng.warmup()
+    return eng
+
+
+def _request_homed(cfg, target, n_replicas, rng, shots=1, tries=256):
+    """A request whose affinity HOME is `target` (draw until the stable
+    fingerprint lands there — p=1/n per draw, so 256 tries is ~never
+    exhausted)."""
+    for _ in range(tries):
+        req = _request(cfg, rng, shots=shots)
+        if home_replica(request_fingerprint(req), n_replicas) == target:
+            return req
+    raise AssertionError("could not draw a request homed on "
+                         f"replica {target}")
+
+
+def test_partition_devices_disjoint_slices():
+    devices = [f"d{i}" for i in range(8)]
+    slices = partition_devices(devices, 3)
+    assert [len(s) for s in slices] == [2, 2, 2]  # remainder unassigned
+    flat = [d for s in slices for d in s]
+    assert len(flat) == len(set(flat))  # disjoint
+    assert partition_devices(devices, 1) == [devices]
+    with pytest.raises(ValueError, match=">= 1"):
+        partition_devices(devices, 0)
+    with pytest.raises(ValueError, match="disjoint"):
+        partition_devices(devices[:2], 3)
+
+
+def test_affinity_fingerprint_stable_across_process_restarts(tmp_path):
+    """The router's home assignment must survive a front-tier restart:
+    the fingerprint is content-hash-based, NEVER the per-process-seeded
+    builtin hash(). Two fresh interpreters with different
+    PYTHONHASHSEEDs must agree with this process bit-for-bit."""
+    import subprocess
+    import sys as _sys
+
+    script = (
+        "import numpy as np\n"
+        "from howtotrainyourmamlpytorch_tpu.serving.router import (\n"
+        "    home_replica, request_fingerprint)\n"
+        "from howtotrainyourmamlpytorch_tpu.serving.batcher import (\n"
+        "    AdaptRequest, IndexRequest)\n"
+        "rng = np.random.RandomState(123)\n"
+        "req = AdaptRequest(\n"
+        "    support_x=rng.randn(3, 1, 10, 10, 1).astype(np.float32),\n"
+        "    support_y=np.tile(\n"
+        "        np.arange(3, dtype=np.int32)[:, None], (1, 1)),\n"
+        "    query_x=rng.randn(3, 2, 10, 10, 1).astype(np.float32),\n"
+        "    query_y=None)\n"
+        "idx = IndexRequest(\n"
+        "    support_idx=np.arange(3, dtype=np.int64)[:, None],\n"
+        "    query_idx=np.arange(6, dtype=np.int64).reshape(3, 2))\n"
+        "print(request_fingerprint(req), home_replica("
+        "request_fingerprint(req), 5))\n"
+        "print(request_fingerprint(idx), home_replica("
+        "request_fingerprint(idx), 5))\n"
+    )
+    outs = []
+    for seed in ("0", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        outs.append(subprocess.run(
+            [_sys.executable, "-c", script], env=env, text=True,
+            capture_output=True, check=True, timeout=120,
+        ).stdout)
+    assert outs[0] == outs[1]
+    # ... and with THIS process (different interpreter lifetime again)
+    from howtotrainyourmamlpytorch_tpu.serving.batcher import IndexRequest
+
+    rng = np.random.RandomState(123)
+    req = AdaptRequest(
+        support_x=rng.randn(3, 1, 10, 10, 1).astype(np.float32),
+        support_y=np.tile(np.arange(3, dtype=np.int32)[:, None], (1, 1)),
+        query_x=rng.randn(3, 2, 10, 10, 1).astype(np.float32),
+        query_y=None,
+    )
+    line0 = outs[0].splitlines()[0].split()
+    assert line0[0] == request_fingerprint(req)
+    assert int(line0[1]) == home_replica(request_fingerprint(req), 5)
+    # the fingerprint deliberately EXCLUDES the snapshot salt: a
+    # checkpoint rollover must not reshuffle homes (the adapted-cache
+    # key embeds the snapshot hash separately and invalidates alone)
+    idx = IndexRequest(
+        support_idx=np.arange(3, dtype=np.int64)[:, None],
+        query_idx=np.arange(6, dtype=np.int64).reshape(3, 2),
+    )
+    assert request_fingerprint(idx) == outs[0].splitlines()[1].split()[0]
+
+
+class _StubReplica:
+    """Router-unit-test replica: health/queue knobs, no engine."""
+
+    def __init__(self, replica_id, depth=0, healthy=True):
+        self.replica_id = replica_id
+        self._depth = depth
+        self.healthy = healthy
+        self.tripped = False
+        self.trip_cause = None
+        self.submitted = []
+
+    def queue_depth(self):
+        return self._depth
+
+    def trip(self, cause=None):
+        if self.tripped:
+            return False
+        self.tripped = True
+        self.healthy = False
+        return True
+
+    def submit(self, request):
+        self.submitted.append(request)
+        return f"pending-{self.replica_id}"
+
+
+def test_router_affinity_spillover_and_rehoming(cfg):
+    """The three routing regimes, isolated on stub replicas: pure
+    affinity when the home is healthy+shallow; least-loaded spillover
+    when the home's backlog reaches spill_depth; deterministic ring
+    re-homing when the home is down."""
+    rng = np.random.RandomState(31)
+    replicas = [_StubReplica(i) for i in range(3)]
+    router = ReplicaRouter(replicas, spill_depth=4)
+    req = _request_homed(cfg, 1, 3, rng)
+
+    # affinity: lands on its home
+    assert router.route(req) is replicas[1]
+    assert router.stats()["routed_affinity"] == 1
+
+    # spillover: saturated home -> least-loaded healthy replica
+    replicas[1]._depth = 4
+    replicas[0]._depth = 2
+    replicas[2]._depth = 1
+    assert router.route(req) is replicas[2]
+    assert router.stats()["routed_spill"] == 1
+
+    # a saturated home that is ALSO the least loaded keeps its traffic
+    replicas[1]._depth = 4
+    replicas[0]._depth = replicas[2]._depth = 9
+    assert router.route(req) is replicas[1]
+
+    # re-homing: a dead home walks the ring DETERMINISTICALLY (1 -> 2),
+    # and the sweep trips the dead replica exactly once
+    replicas[0]._depth = replicas[1]._depth = replicas[2]._depth = 0
+    replicas[1].healthy = False
+    assert router.route(req) is replicas[2]
+    assert replicas[1].tripped
+    stats = router.stats()
+    assert stats["routed_rehomed"] == 1 and stats["trips"] == 1
+    assert router.route(req) is replicas[2]  # stable fallback
+    assert router.stats()["trips"] == 1  # idempotent sweep
+
+    # the whole pool down is a routing error carrying per-replica causes
+    from howtotrainyourmamlpytorch_tpu.serving.router import (
+        AllReplicasUnhealthyError,
+    )
+
+    for r in replicas:
+        r.healthy = False
+    with pytest.raises(AllReplicasUnhealthyError):
+        router.route(req)
+
+
+def test_pool_bit_exact_vs_single_engine(pool_cfg, pool, single_engine):
+    """The pool-level correctness contract: routing a request stream
+    through the N-replica pool returns byte-identical TenantResults to
+    the single comparator engine (same snapshot, same per-request
+    dispatch width — width-matched, because XLA codegen is
+    width-dependent)."""
+    rng = np.random.RandomState(41)
+    requests = [_request(cfg=pool_cfg, rng=rng) for _ in range(6)]
+    router = ReplicaRouter(pool, spill_depth=10_000)
+    homes = {
+        home_replica(request_fingerprint(r), pool.n_replicas)
+        for r in requests
+    }
+    assert len(homes) == 2  # the draw exercises both replicas
+    for req in requests:
+        pooled = router.submit(req).get(timeout=300)
+        single = single_engine.serve_group([req]).results[0]
+        assert np.array_equal(pooled.preds, single.preds)
+        assert pooled.loss == single.loss
+        assert pooled.accuracy == single.accuracy
+    stats = router.stats()
+    assert stats["routed_total"] == 6
+    assert stats["routed_affinity"] == 6  # nothing spilled or re-homed
+
+
+def test_affinity_preserves_cache_hits_across_pool(pool_cfg, pool):
+    """Scale-out must not dilute the adapted-params cache: a repeat
+    tenant hashes to the SAME home replica, whose LRU still holds its
+    adapted params — every repeat is a hit, exactly as on one engine."""
+    rng = np.random.RandomState(43)
+    requests = [_request(cfg=pool_cfg, rng=rng) for _ in range(4)]
+    router = ReplicaRouter(pool, spill_depth=10_000)
+    hits_before = {
+        r.replica_id: r.engine.cache_hits for r in pool.replicas
+    }
+    for req in requests:  # first pass: misses populate each home's LRU
+        router.submit(req).get(timeout=300)
+    for req in requests:  # second pass: every repeat hits its home
+        router.submit(req).get(timeout=300)
+    hits = sum(
+        r.engine.cache_hits - hits_before[r.replica_id]
+        for r in pool.replicas
+    )
+    assert hits == len(requests)
+    # per-replica telemetry stays attributable: pooled records carry
+    # replica_id (schema v11) and validate
+    recs = [
+        r for r in pool.sink.records if r.get("kind") == "serving"
+        and r.get("event") == "dispatch"
+    ]
+    assert recs and all(r["replica_id"] in (0, 1) for r in recs)
+    for r in recs[-4:]:
+        tel.validate_record(r)
+
+
+def test_pool_rollup_aggregates_per_replica(pool):
+    """The pool rollup: per-replica rollups tagged with replica_id plus
+    honest aggregates (tenants summed; tenants_per_sec over the UNION
+    span, never a sum of overlapping per-replica rates)."""
+    ru = pool.rollup()
+    assert ru["replicas"] == 2
+    assert [p["replica_id"] for p in ru["per_replica"]] == [0, 1]
+    assert ru["tenants"] == sum(p["tenants"] for p in ru["per_replica"])
+    assert ru["dispatches"] == sum(
+        p["dispatches"] for p in ru["per_replica"]
+    )
+    assert ru["tenants_per_sec"] > 0
+    assert 0.0 <= ru["cache_hit_rate"] <= 1.0
+    assert ru["retraces"] == 0
+
+
+@pytest.mark.slow
+def test_circuit_break_rehome_recover(pool_cfg, state):
+    """The full breaker lifecycle on a real 2-replica pool: a replica
+    whose engine dies is tripped on the next routing sweep (queued
+    futures fail NOW with the chained root cause), its traffic re-homes
+    deterministically, and a restart_replica'd replacement is picked up
+    automatically — circuit-break -> re-home -> recover."""
+    tiny = make_serving_cfg(
+        serving_bucket_ladder=[1], serving_max_tenants_per_dispatch=1
+    )
+    ps = ReplicaSet(
+        tiny, state, n_replicas=2, devices=jax.devices()[:2],
+        shots_buckets=(1,), strict_retrace=True,
+    )
+    ps.warmup()
+    try:
+        rng = np.random.RandomState(47)
+        router = ReplicaRouter(ps, spill_depth=10_000)
+        victim = 0
+        req_home0 = _request_homed(tiny, victim, 2, rng)
+        assert router.submit(req_home0).get(timeout=300) is not None
+
+        # kill replica 0's engine mid-service (the post-donation-crash
+        # shape: the engine latches _dead with the root cause)
+        boom = RuntimeError("replica 0 device fell over")
+
+        def _explode(*a, **k):
+            raise boom
+
+        eng0 = ps.replicas[victim].engine
+        eng0._programs = {key: _explode for key in eng0._programs}
+        dead_pending = router.submit(_request_homed(tiny, victim, 2, rng))
+        with pytest.raises(RuntimeError, match="device fell over"):
+            dead_pending.get(timeout=300)
+        assert not ps.replicas[victim].healthy
+
+        # stash a queued future on the broken replica: the trip must
+        # fail it immediately with the chained cause, NOT strand it
+        stranded = ps.replicas[victim].batcher.submit(
+            _request_homed(tiny, victim, 2, rng)
+        )
+
+        # next routed request sweeps health -> trips replica 0 ->
+        # re-homes to replica 1 and SUCCEEDS
+        rerouted = router.submit(_request_homed(tiny, victim, 2, rng))
+        assert rerouted.get(timeout=300) is not None
+        assert ps.replicas[victim].tripped
+        stats = router.stats()
+        assert stats["trips"] == 1 and stats["routed_rehomed"] == 1
+        with pytest.raises(RuntimeError) as ei:
+            stranded.get(timeout=60)
+        # the breaker chains the ORIGINAL root cause through the error
+        causes = []
+        exc = ei.value
+        while exc is not None:
+            causes.append(exc)
+            exc = exc.__cause__
+        assert boom in causes
+        # direct submits to a tripped replica are refused with the cause
+        with pytest.raises(RuntimeError, match="circuit-broken"):
+            ps.replicas[victim].submit(_request_homed(tiny, victim, 2, rng))
+
+        # recover: a fresh warmed replica takes the slot and its
+        # affinity traffic comes home (the router reads the live pool)
+        fresh = ps.restart_replica(victim, state)
+        assert fresh.healthy
+        back_home = _request_homed(tiny, victim, 2, rng)
+        assert router.route(back_home) is fresh
+        assert router.submit(back_home).get(timeout=300) is not None
+    finally:
+        ps.close()
+
+
+def test_batcher_close_immediate_on_never_warmed_engine(pool_cfg, state):
+    """Regression (the breaker-drain fix): close() against an engine
+    that never completed warmup() must NOT block on the worker join for
+    the full max-wait, and must NOT dispatch the backlog (that would pay
+    the whole lazy-compile bill just to tear the replica down) — the
+    queued futures fail promptly instead."""
+    import time as _time
+
+    eng = ServingEngine(
+        pool_cfg, state, shots_buckets=(1,), strict_retrace=False,
+    )
+    assert not eng.warmup_stats  # never warmed
+    batcher = MicroBatcher(eng, max_wait_ms=30_000.0)
+    rng = np.random.RandomState(53)
+    pending = batcher.submit(_request(pool_cfg, rng))
+    start = _time.perf_counter()
+    batcher.close()
+    elapsed = _time.perf_counter() - start
+    assert elapsed < 5.0, (
+        f"close() of a never-warmed engine took {elapsed:.1f}s — it must "
+        "shut down immediately, not wait out max_wait/compile the ladder"
+    )
+    with pytest.raises(RuntimeError, match="never warmed or is dead"):
+        pending.get(timeout=10)
+    # drain=True still forces the old serve-the-backlog semantics on a
+    # WARMED engine (the graceful pool shutdown path)
+    warmed = ServingEngine(
+        pool_cfg, state, shots_buckets=(1,), strict_retrace=False,
+    )
+    warmed.warmup()
+    b2 = MicroBatcher(warmed, max_wait_ms=30_000.0)
+    p2 = b2.submit(_request(pool_cfg, rng))
+    b2.close(drain=True)
+    assert p2.get(timeout=10) is not None
+
+
+@pytest.mark.slow
+def test_replica_swap_engine_zero_compile_mid_traffic(pool_cfg, state):
+    """The rollover primitive: a WARMED standby swaps in under the
+    dispatch lock with zero XLA compiles at swap time and zero dropped
+    requests; a cold standby is refused outright."""
+    ps = ReplicaSet(
+        pool_cfg, state, n_replicas=1, devices=jax.devices()[:1],
+        shots_buckets=(1,), strict_retrace=True,
+    )
+    ps.warmup()
+    try:
+        replica = ps.replicas[0]
+        rng = np.random.RandomState(59)
+        cold = ps.build_standby_engine(0, state)
+        with pytest.raises(ValueError, match="warmup"):
+            replica.swap_engine(cold)
+
+        before = replica.submit(_request(pool_cfg, rng))
+        standby = ps.build_standby_engine(0, state)
+        standby.warmup()  # compiles HERE, off the swap path
+        swap = replica.swap_engine(standby)
+        after = replica.submit(_request(pool_cfg, rng))
+        assert swap["xla_compiles_at_swap"] == 0
+        assert swap["replica_id"] == 0
+        assert before.get(timeout=300) is not None
+        assert after.get(timeout=300) is not None
+        assert replica.engine is standby
+    finally:
+        ps.close()
+
+
+@pytest.mark.slow
+def test_refresh_daemon_rolls_pool_on_new_checkpoint(pool_cfg, state,
+                                                     tmp_path):
+    """The watch -> prefetch/pre-warm -> swap lifecycle end to end: the
+    daemon ignores the primed snapshot, detects a NEW checkpoint
+    marker, warms a standby per replica off the hot path, swaps with
+    zero compiles, emits schema-v11 rollover records, and the pool
+    serves the new snapshot."""
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    save_dir = str(tmp_path / "saved_models")
+    ckpt.save_checkpoint(
+        save_dir, "train_model", "latest", state, {"current_iter": 0}
+    )
+    sink = _ListSink()
+    ps = ReplicaSet(
+        pool_cfg, state, n_replicas=1, devices=jax.devices()[:1],
+        shots_buckets=(1,), sink=sink, strict_retrace=True,
+    )
+    ps.warmup()
+    try:
+        daemon = RefreshDaemon(
+            ps, pool_cfg, save_dir, poll_s=0.05, sink=sink
+        )
+        daemon.prime()
+        assert daemon.poll_once() is None  # nothing new
+        assert daemon.rollovers == 0
+
+        # training writes a NEW snapshot (perturbed, so the roll is
+        # observable in the served outputs)
+        rolled_state = jax.tree_util.tree_map(
+            lambda x: x + 0.25 if np.issubdtype(
+                np.asarray(x).dtype, np.floating) else x,
+            state,
+        )
+        ckpt.save_checkpoint(
+            save_dir, "train_model", "latest", rolled_state,
+            {"current_iter": 9},
+        )
+        stats = daemon.poll_once()
+        assert stats is not None and len(stats) == 1
+        assert stats[0]["xla_compiles_at_swap"] == 0
+        assert stats[0]["old_iter"] == 0 and stats[0]["new_iter"] == 9
+        assert daemon.rollovers == 1 and daemon.last_error is None
+        assert daemon.poll_once() is None  # idempotent until the next
+
+        rollover_recs = [
+            r for r in sink.records
+            if r.get("kind") == "serving" and r.get("event") == "rollover"
+        ]
+        assert len(rollover_recs) == 1
+        tel.validate_record(rollover_recs[0])
+        assert rollover_recs[0]["new_iter"] == 9
+
+        # the pool now serves the ROLLED snapshot: compare against a
+        # fresh engine over rolled_state (width-matched single dispatch)
+        rng = np.random.RandomState(61)
+        req = _request(pool_cfg, rng)
+        served = ps.replicas[0].submit(req).get(timeout=300)
+        cmp_eng = ServingEngine(
+            pool_cfg, rolled_state, shots_buckets=(1,),
+            strict_retrace=False,
+        )
+        cmp_eng.warmup()
+        expect = cmp_eng.serve_group([req]).results[0]
+        assert np.array_equal(served.preds, expect.preds)
+    finally:
+        ps.close()
+
+
+def test_pool_config_validation():
+    """The scale-out knobs validate like every serving int/float."""
+    make_serving_cfg(serving_replicas=2, serving_router_spill_depth=3,
+                     serving_rollover_poll_s=0.5)
+    coerced = make_serving_cfg(serving_replicas=2.0)
+    assert coerced.serving_replicas == 2  # JSON round-trip coercion
+    with pytest.raises(ValueError, match="serving_replicas"):
+        make_serving_cfg(serving_replicas=0)
+    with pytest.raises(ValueError, match="serving_router_spill_depth"):
+        make_serving_cfg(serving_router_spill_depth=0)
+    with pytest.raises(ValueError, match="serving_rollover_poll_s"):
+        make_serving_cfg(serving_rollover_poll_s=0.0)
+    with pytest.raises(ValueError, match="spill_depth"):
+        ReplicaRouter([_StubReplica(0)], spill_depth=0)
+
+
+def test_metrics_per_replica_labels_and_rollovers():
+    """Schema v11 metrics: pooled records keep one series per replica
+    label, unlabelled single-engine records render exactly as before,
+    and rollover events count into serving_rollovers_total — all
+    through the real exposition parser."""
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+        ServingMetrics,
+        parse_prometheus_text,
+    )
+
+    metrics = ServingMetrics()
+    base = dict(kind="serving", event="dispatch", program="adapt",
+                adapt_ms=2.0, queue_ms=0.1, ingest_bytes=100,
+                cache_hits=1)
+    metrics.write(dict(base, tenants=3, replica_id=0))
+    metrics.write(dict(base, tenants=2, replica_id=1))
+    metrics.write(dict(base, tenants=4))  # single-engine: unlabelled
+    metrics.write({"kind": "serving", "event": "rollover",
+                   "replica_id": 1})
+    metrics.observe_queue_depth(5, replica=0)
+    series = parse_prometheus_text(metrics.render())
+    req = series["serving_requests_total"]
+    assert req['replica="0"'] == 3 and req['replica="1"'] == 2
+    assert req[""] == 4
+    disp = series["serving_dispatches_total"]
+    assert disp['program="adapt",replica="0"'] == 1
+    assert disp['program="adapt"'] == 1
+    assert series["serving_rollovers_total"]['replica="1"'] == 1
+    assert series["serving_queue_depth"]['replica="0"'] == 5
+    assert series["serving_cache_hits_total"]['replica="0"'] == 1
+
+
+def test_healthz_pool_readiness_gates_503(pool):
+    """/healthz with a pool readiness probe: 503 (with per-replica
+    detail) until EVERY replica is ready, 200 after; the readiness-less
+    single-engine server keeps its unconditional 200."""
+    import urllib.error
+    import urllib.request
+
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+        MetricsServer,
+        ServingMetrics,
+    )
+
+    states = {"0": True, "1": False}
+    server = MetricsServer(
+        ServingMetrics(), port=0, readiness=lambda: states
+    )
+    try:
+        url = f"http://{server.host}:{server.port}/healthz"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=10)
+        assert ei.value.code == 503
+        body = ei.value.read().decode()
+        assert "replica 1: not-ready" in body
+        assert "replica 0: ready" in body
+
+        states["1"] = True
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.read().decode().startswith("ok")
+
+        # the REAL pool's readiness surface reports every replica warm
+        assert pool.readiness() == {"0": True, "1": True}
+    finally:
+        server.close()
+
+    plain = MetricsServer(ServingMetrics(), port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://{plain.host}:{plain.port}/healthz", timeout=10
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        plain.close()
+
+
+@pytest.mark.slow
+def test_serve_bench_replicas_pool_end_to_end(tmp_path, capsys):
+    """`cli serve-bench --fast --replicas 2`: the pool line carries the
+    aggregate + per-replica + router surfaces with zero drops, the
+    telemetry log is schema-valid with replica-tagged records, and the
+    inspect summary renders the per-replica breakdown."""
+    from howtotrainyourmamlpytorch_tpu.serving import bench as serve_bench
+    from howtotrainyourmamlpytorch_tpu.tools import telemetry_cli
+
+    log = tmp_path / "pool.jsonl"
+    rc = serve_bench.main(
+        ["--fast", "--requests", "8", "--replicas", "2",
+         "--repeat-tenant-fraction", "0.5", "--emulate-device-ms", "2",
+         "--telemetry", str(log), "--metrics-port", "0"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["replicas"] == 2
+    assert rec["tenants"] == 8 and rec["dropped_requests"] == 0
+    assert rec["retraces"] == 0
+    assert rec["tenants_per_sec"] > 0
+    assert len(rec["per_replica"]) == 2
+    assert rec["router"]["routed_total"] == 8
+    assert rec["router"]["routed_spill"] == 0  # bench default: no spill
+    assert rec["cache_hit_rate"] is not None
+    assert rec["emulate_device_ms"] == 2.0
+    tel.validate_file(str(log))
+    tagged = [
+        r for r in tel.iter_records(str(log))
+        if r["kind"] == "serving" and r.get("event") == "dispatch"
+    ]
+    assert tagged and all("replica_id" in r for r in tagged)
+    assert telemetry_cli.main(["summary", str(log)]) == 0
+    summary_out = capsys.readouterr().out
+    assert "serving[replica 0]:" in summary_out
+    assert "2 replica(s)" in summary_out
+
+
+def test_router_skips_cold_replica_without_tripping(cfg):
+    """A merely not-yet-warmed replica is UNHEALTHY for routing but not
+    BROKEN: the sweep must skip it (it becomes routable when warmup
+    completes), never destructively trip it — tripping fails its
+    backlog and closes its batcher permanently."""
+    rng = np.random.RandomState(67)
+    replicas = [_StubReplica(i) for i in range(2)]
+    replicas[0].healthy = False   # cold: warmup still running
+    replicas[0].broken = False
+    replicas[1].broken = False
+    router = ReplicaRouter(replicas, spill_depth=4)
+    req = _request_homed(cfg, 0, 2, rng)
+    assert router.route(req) is replicas[1]  # re-homed, not tripped
+    assert not replicas[0].tripped
+    assert router.stats()["trips"] == 0
+    replicas[0].healthy = True               # warmup completed
+    assert router.route(req) is replicas[0]  # traffic comes home
+    # a BROKEN replica (dead engine/worker) is tripped as before
+    replicas[0].healthy = False
+    replicas[0].broken = True
+    assert router.route(req) is replicas[1]
+    assert replicas[0].tripped and router.stats()["trips"] == 1
+
+
+def test_refresh_marker_peek_is_read_only(pool_cfg, state, tmp_path):
+    """The daemon polls a LIVE training run's checkpoint dir: its
+    marker peek must never perform the `.old` recovery rename (that
+    race can crash the trainer's own save mid-swap) — same read-only
+    contract as load_servable_snapshot."""
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    save_dir = str(tmp_path / "saved_models")
+    ckpt.save_checkpoint(
+        save_dir, "train_model", "latest", state, {"current_iter": 4}
+    )
+    path = os.path.join(save_dir, "train_model_latest")
+    os.rename(path, path + ".old")  # trainer killed between renames
+    daemon = RefreshDaemon(None, pool_cfg, save_dir)
+    assert daemon.current_marker() == 4  # read FROM the .old sibling
+    assert os.path.isdir(path + ".old") and not os.path.isdir(path)
+
+
+@pytest.mark.slow
+def test_refresh_partial_failure_resumes_without_double_swap(
+        state, tmp_path):
+    """A mid-pool rollover failure (replica 1's standby build dies
+    after replica 0 already swapped) must resume at the FAILED replica
+    on the next poll — never re-roll, or double-count rollover records
+    for, the replicas that already swapped onto the target marker."""
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+    tiny = make_serving_cfg(
+        serving_bucket_ladder=[1], serving_max_tenants_per_dispatch=1
+    )
+    save_dir = str(tmp_path / "saved_models")
+    ckpt.save_checkpoint(
+        save_dir, "train_model", "latest", state, {"current_iter": 0}
+    )
+    sink = _ListSink()
+    ps = ReplicaSet(
+        tiny, state, n_replicas=2, devices=jax.devices()[:2],
+        shots_buckets=(1,), sink=sink, strict_retrace=True,
+    )
+    ps.warmup()
+    try:
+        daemon = RefreshDaemon(ps, tiny, save_dir, poll_s=0.05, sink=sink)
+        daemon.prime()
+        ckpt.save_checkpoint(
+            save_dir, "train_model", "latest", state, {"current_iter": 5}
+        )
+        orig_build = ps.build_standby_engine
+        armed = [True]
+
+        def flaky(rid, st, snapshot_id=None):
+            if rid == 1 and armed[0]:
+                armed[0] = False
+                raise OSError("transient fs hiccup")
+            return orig_build(rid, st, snapshot_id)
+
+        ps.build_standby_engine = flaky
+        assert daemon.poll_once() is None  # partial: latched, retried
+        assert daemon.last_error is not None
+        assert daemon.rollovers == 0
+        stats = daemon.poll_once()  # retry resumes at replica 1 ONLY
+        assert [s["replica_id"] for s in stats] == [1]
+        assert daemon.rollovers == 1 and daemon.last_error is None
+        rollover_recs = [
+            r for r in sink.records
+            if r.get("kind") == "serving" and r.get("event") == "rollover"
+        ]
+        assert sorted(r["replica_id"] for r in rollover_recs) == [0, 1]
+    finally:
+        ps.close()

@@ -880,3 +880,49 @@ def test_make_record_omits_counts_when_all_finite():
     rec = tel.make_record("epoch", epoch=0, scalars={"loss": 1.0})
     assert "nonfinite_count" not in rec
     assert "nonfinite_fields" not in rec
+
+
+# -- schema v11: multi-replica serving (replica_id + rollover) ---------------
+
+
+def test_validate_file_accepts_v10_era_fixture():
+    """The pinned v10-era log (the causal-tracing span shape and the
+    serving latency decomposition of the PREVIOUS schema) validates
+    unchanged under v11 — pure addition, nothing tightened."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v10_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 8
+
+
+def test_v11_rollover_record_validates():
+    """The v11 rollover shape (serving/refresh.py): one replica's
+    zero-downtime checkpoint swap, full field set through make_record."""
+    rec = tel.make_record(
+        "serving", event="rollover", replica_id=1, old_iter=500,
+        new_iter=750, standby_warmup_s=2.125, standby_warmup_mode="artifacts",
+        swap_ms=0.031, xla_compiles_at_swap=0, rollover_s=2.5,
+    )
+    assert rec["schema"] == tel.SCHEMA_VERSION
+    tel.validate_record(rec)
+    json.dumps(rec, allow_nan=False)
+
+
+def test_v11_replica_id_rides_serving_records():
+    """replica_id is a pure addition on every serving shape: dispatch /
+    rollup records validate with it AND without it (single-engine logs
+    are unchanged — the field is simply absent)."""
+    tel.validate_record(tel.make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.5, adapt_ms=4.0, program="adapt", ingest="f32",
+        ingest_bytes=2048, cache_hits=0, replica_id=3,
+    ))
+    tel.validate_record(tel.make_record(
+        "serving", event="rollup", dispatches=4, tenants=8,
+        adapt_ms_p50=3.0, adapt_ms_p95=6.0, tenants_per_sec=99.0,
+        retraces=0, replica_id=0,
+    ))
+    tel.validate_record(tel.make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.5, adapt_ms=4.0, program="adapt", ingest="f32",
+    ))

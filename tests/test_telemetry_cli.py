@@ -531,3 +531,72 @@ def test_cli_trace_dispatch_is_jax_free(tmp_path):
         timeout=120,
     )
     assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_summary_per_replica_serving_breakdown(tmp_path, capsys):
+    """Schema v11: dispatch records tagged with replica_id grow a
+    per-replica summary line (traffic spread + per-replica cache
+    locality) and rollover events are counted; pre-v11 records without
+    the field produce no replica rows and never crash."""
+    records = _run_records([0.5])
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.5, adapt_ms=4.0, program="adapt", ingest="f32",
+        ingest_bytes=1024, cache_hits=0, replica_id=0,
+    ))
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=4, bucket=4, shots=1,
+        queue_ms=0.5, adapt_ms=8.0, program="adapt", ingest="f32",
+        ingest_bytes=2048, cache_hits=2, replica_id=1,
+    ))
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=2, bucket=2, shots=1,
+        queue_ms=0.1, adapt_ms=2.0, program="predict", ingest="f32",
+        ingest_bytes=512, cache_hits=2, replica_id=1,
+    ))
+    records.insert(-1, make_record(
+        "serving", event="rollover", replica_id=0, old_iter=0,
+        new_iter=9, swap_ms=0.05, xla_compiles_at_swap=0,
+    ))
+    # a malformed replica_id must be skipped, never crash the summary
+    records.insert(-1, make_record(
+        "serving", event="dispatch", tenants=1, bucket=1, shots=1,
+        queue_ms=0.1, adapt_ms=1.0, program="adapt", ingest="f32",
+        replica_id="not-an-int",
+    ))
+    log = _write_log(tmp_path / "pool.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    sv = payload["serving"]
+    assert sv["rollovers"] == 1
+    assert set(sv["per_replica"]) == {"0", "1"}
+    assert sv["per_replica"]["0"]["tenants"] == 2
+    assert sv["per_replica"]["1"]["tenants"] == 6
+    assert sv["per_replica"]["1"]["cache_hit_rate"] == round(4 / 6, 4)
+    assert cli_main(["summary", log]) == 0
+    out = capsys.readouterr().out
+    assert "serving[replica 0]:" in out
+    assert "serving[replica 1]:" in out
+    assert "2 replica(s)" in out
+    assert "1 rollover(s)" in out
+
+
+def test_summary_pre_v11_serving_log_has_no_replica_rows(tmp_path, capsys):
+    """A pre-v11 log (serving records without replica_id) keeps the
+    exact pre-pool summary shape: no per-replica lines, no rollovers,
+    exit 0."""
+    records = _run_records([0.5])
+    records.insert(-1, {
+        "schema": 10, "ts": 1.0, "kind": "serving", "event": "dispatch",
+        "tenants": 3, "bucket": 4, "shots": 1, "queue_ms": 0.9,
+        "adapt_ms": 4.4, "program": "adapt", "ingest": "f32",
+    })
+    log = _write_log(tmp_path / "v10.jsonl", records)
+    assert cli_main(["summary", log, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["serving"]["per_replica"] == {}
+    assert payload["serving"]["rollovers"] == 0
+    assert cli_main(["summary", log]) == 0
+    out = capsys.readouterr().out
+    assert "serving[replica" not in out
+    assert "rollover" not in out
