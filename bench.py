@@ -695,6 +695,7 @@ _WORKLOAD_KNOBS = (
     "BENCH_COMPUTE_DTYPE", "BENCH_USE_REMAT", "BENCH_REMAT_POLICY",
     "BENCH_CONV_IMPL", "BENCH_POOL_IMPL", "BENCH_TASK_AXIS_MODE",
     "BENCH_PAD_CHANNELS", "BENCH_META_ACCUM_STEPS",
+    "BENCH_BN_STATS_IMPL", "BENCH_IM2COL_HOIST",
 )
 
 # The hlo_cost / donation helpers (cost-analysis normalization, optimized-
@@ -753,6 +754,12 @@ def main() -> None:
         overrides["task_axis_mode"] = os.environ["BENCH_TASK_AXIS_MODE"]
     if "BENCH_POOL_IMPL" in os.environ:
         overrides["pool_impl"] = os.environ["BENCH_POOL_IMPL"]
+    # the PR-16 compute-diet levers: BN statistics pass and invariant
+    # im2col hoisting (config validates; pool_impl above is the third)
+    if "BENCH_BN_STATS_IMPL" in os.environ:
+        overrides["bn_stats_impl"] = os.environ["BENCH_BN_STATS_IMPL"]
+    if "BENCH_IM2COL_HOIST" in os.environ:
+        overrides["im2col_hoist"] = os.environ["BENCH_IM2COL_HOIST"]
     if "BENCH_PAD_CHANNELS" in os.environ:
         # 'auto' | 'off' | 'tile' | integer multiple (config validates)
         overrides["pad_channels"] = os.environ["BENCH_PAD_CHANNELS"]
@@ -997,6 +1004,8 @@ def main() -> None:
         "conv_impl": cfg.resolved_conv_impl,
         "pool_impl": cfg.resolved_pool_impl,
         "pad_channels": cfg.resolved_pad_channels,
+        "bn_stats_impl": cfg.resolved_bn_stats_impl,
+        "im2col_hoist": cfg.resolved_im2col_hoist,
         "meta_accum_steps": cfg.meta_accum_steps,
         "task_axis_mode": cfg.task_axis_mode,
         "use_remat": cfg.use_remat,
@@ -1060,8 +1069,9 @@ def main() -> None:
     # defaults landed) is stale, not a comparison point.
     _COMPARABLE_KEYS = (
         "backend", "dtype", "batch_size", "n_chips", "conv_impl",
-        "pool_impl", "pad_channels", "meta_accum_steps", "task_axis_mode",
-        "use_remat", "remat_policy", "matmul_precision", "workload",
+        "pool_impl", "pad_channels", "bn_stats_impl", "im2col_hoist",
+        "meta_accum_steps", "task_axis_mode", "use_remat", "remat_policy",
+        "matmul_precision", "workload",
     )
     comparable = (
         baseline_rec is not None
@@ -1074,6 +1084,27 @@ def main() -> None:
         )
     elif baseline_rec is not None:
         result["baseline_backend"] = baseline_rec.get("backend")
+        # the compute-diet knobs (PR 16) remove bytes and redundant
+        # elementwise/reduction work, never model FLOPs: a run that
+        # differs from the baseline ONLY in those knobs must agree with
+        # it on xla_flops_per_task to ±5% — a bigger drift means a lever
+        # silently changed the math, and the line must not be trusted
+        _DIET_KNOBS = ("pool_impl", "bn_stats_impl", "im2col_hoist")
+        others_match = all(
+            baseline_rec.get(k) == result[k]
+            for k in _COMPARABLE_KEYS if k not in _DIET_KNOBS
+        )
+        base_flops = baseline_rec.get("xla_flops_per_task")
+        if others_match and base_flops and result["xla_flops_per_task"]:
+            ratio = float(result["xla_flops_per_task"]) / float(base_flops)
+            if abs(ratio - 1.0) > 0.05:
+                raise SystemExit(
+                    f"bench: xla_flops_per_task drifted {ratio:.3f}x vs "
+                    "baseline across compute-diet knobs (must be within "
+                    "±5%: the diet removes bytes, not FLOPs) — "
+                    f"{result['xla_flops_per_task']} vs {base_flops}"
+                )
+            result["flops_vs_baseline"] = round(ratio, 4)
 
     if backend == "tpu" and not comparable and default_knob_run and \
             os.environ.get("BENCH_NO_BASELINE_WRITE") != "1":

@@ -2,11 +2,12 @@
 
 ROADMAP item 2's gap had a mechanical cause: the lowering knobs that
 decide whether the train step saturates the MXU (``conv_impl``,
-``pad_channels``, ``remat_policy``, and — since this PR —
-``meta_accum_steps``) were resolved by *heuristics*, and the heuristics
-lost quietly (BENCH_BASELINE.json records ``conv_impl='lax'`` at 2.5%
-MFU on a machine where the gemm path existed). This module replaces the
-guess with a measurement:
+``pad_channels``, ``remat_policy``, ``meta_accum_steps``, and — since
+PR 16's inner-loop compute diet — ``bn_stats_impl`` and ``pool_impl``)
+were resolved by *heuristics*, and the heuristics lost quietly
+(BENCH_BASELINE.json records ``conv_impl='lax'`` at 2.5% MFU on a
+machine where the gemm path existed). This module replaces the guess
+with a measurement:
 
 * ``cli tune`` sweeps the knob grid with ``bench.py``'s harness (one
   subprocess per point — the same timed-step protocol, donation and
@@ -41,14 +42,21 @@ TUNING_VERSION = 1
 #: operators can ship a pod-wide table without touching the checkout)
 TUNING_TABLE_ENV = "MAML_TUNING_TABLE"
 
-#: the swept knobs, in the order they appear in point labels
+#: the swept knobs, in the order they appear in point labels.
+#: ``bn_stats_impl`` / ``pool_impl`` joined in PR 16 (the inner-loop
+#: compute diet): both change the scan body's reduction structure, so the
+#: table — not the heuristic — decides per device kind whether the fused
+#: BN statistics pass and the reshape pool win.
 SWEEP_KNOBS: Tuple[str, ...] = (
     "conv_impl", "pad_channels", "remat_policy", "meta_accum_steps",
+    "bn_stats_impl", "pool_impl",
 )
 
 _VALID_CONV_IMPL = ("lax", "im2col", "gemm")
 _VALID_PAD = ("off", "tile")
 _VALID_REMAT = ("full", "save_conv")
+_VALID_BN_STATS = ("twopass", "fused")
+_VALID_POOL = ("reshape", "reduce_window")
 
 
 def default_table_path() -> str:
@@ -119,6 +127,23 @@ def validate_tuning_table(data: Any) -> None:
             raise ValueError(
                 f"entry {key!r}: meta_accum_steps {accum!r} must be an "
                 "int >= 1"
+            )
+        # the PR-16 axes are validated when present but not REQUIRED: a
+        # table measured before the sweep grew them still pins its
+        # conv/pad/remat/accum winners (the resolvers fall back to the
+        # heuristic for the missing knobs); every table this version
+        # writes carries both, and the CI gate asserts that on the
+        # freshly-swept table
+        bn_stats = entry.get("bn_stats_impl")
+        if bn_stats is not None and bn_stats not in _VALID_BN_STATS:
+            raise ValueError(
+                f"entry {key!r}: bn_stats_impl {bn_stats!r} not in "
+                f"{_VALID_BN_STATS}"
+            )
+        pool = entry.get("pool_impl")
+        if pool is not None and pool not in _VALID_POOL:
+            raise ValueError(
+                f"entry {key!r}: pool_impl {pool!r} not in {_VALID_POOL}"
             )
         tps = entry.get("tasks_per_sec_per_chip")
         if not isinstance(tps, (int, float)) or isinstance(tps, bool) or (
@@ -200,17 +225,21 @@ def sweep_points(fast: bool = False) -> List[Dict[str, Any]]:
 
     ``fast`` (the CI smoke): 2 points that still cross every axis once —
     enough to prove the harness end to end without a grid of bench runs.
-    Full: conv_impl x pad_channels x remat_policy x meta_accum_steps —
-    the grid ROADMAP item 2 names (36 points; each is one reduced bench
-    run, so the full sweep is an hours-scale hardware session, which is
-    the point: measured once per device generation, consulted forever).
+    Full: conv_impl x pad_channels x remat_policy x meta_accum_steps x
+    bn_stats_impl x pool_impl — the ROADMAP-item-2 lowering grid crossed
+    with the PR-16 compute-diet axes (144 points; each is one reduced
+    bench run, so the full sweep is an hours-scale hardware session,
+    which is the point: measured once per device generation, consulted
+    forever).
     """
     if fast:
         return [
             {"conv_impl": "gemm", "pad_channels": "tile",
-             "remat_policy": "save_conv", "meta_accum_steps": 1},
+             "remat_policy": "save_conv", "meta_accum_steps": 1,
+             "bn_stats_impl": "fused", "pool_impl": "reshape"},
             {"conv_impl": "im2col", "pad_channels": "off",
-             "remat_policy": "full", "meta_accum_steps": 2},
+             "remat_policy": "full", "meta_accum_steps": 2,
+             "bn_stats_impl": "twopass", "pool_impl": "reduce_window"},
         ]
     points = []
     conv_impls = ["lax", "gemm", "im2col"]
@@ -218,17 +247,24 @@ def sweep_points(fast: bool = False) -> List[Dict[str, Any]]:
         for pad in ("off", "tile"):
             for remat in ("full", "save_conv"):
                 for accum in (1, 2, 4):
-                    points.append({
-                        "conv_impl": conv_impl,
-                        "pad_channels": pad,
-                        "remat_policy": remat,
-                        "meta_accum_steps": accum,
-                    })
+                    for bn_stats in ("twopass", "fused"):
+                        for pool in ("reshape", "reduce_window"):
+                            points.append({
+                                "conv_impl": conv_impl,
+                                "pad_channels": pad,
+                                "remat_policy": remat,
+                                "meta_accum_steps": accum,
+                                "bn_stats_impl": bn_stats,
+                                "pool_impl": pool,
+                            })
     return points
 
 
 def point_label(point: Dict[str, Any]) -> str:
-    return ",".join(f"{k}={point[k]}" for k in SWEEP_KNOBS)
+    # tolerate pre-PR-16 points (no bn_stats_impl/pool_impl axes)
+    return ",".join(
+        f"{k}={point[k]}" for k in SWEEP_KNOBS if k in point
+    )
 
 
 #: sub-measurements every sweep point skips — points rank train-step
@@ -284,6 +320,8 @@ def run_bench_point(
     env["BENCH_REMAT_POLICY"] = str(point["remat_policy"])
     env["BENCH_USE_REMAT"] = "true"
     env["BENCH_META_ACCUM_STEPS"] = str(point["meta_accum_steps"])
+    env["BENCH_BN_STATS_IMPL"] = str(point["bn_stats_impl"])
+    env["BENCH_POOL_IMPL"] = str(point["pool_impl"])
     if extra_env:
         env.update(extra_env)
     script = bench_script_path()
@@ -428,6 +466,14 @@ def build_table(
             "batch_size": rec.get("batch_size"),
             "reduced": rec.get("reduced"),
         }
+        # the PR-16 diet axes, recorded when the point swept them (bench
+        # echoes the RESOLVED value; pre-PR-16 result records have
+        # neither and their entries stay knob-free, which validate
+        # accepts)
+        for knob in ("bn_stats_impl", "pool_impl"):
+            val = rec.get(knob, point.get(knob))
+            if val is not None:
+                table["entries"][key][knob] = str(val)
     return table
 
 
@@ -438,10 +484,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tune",
         description="Sweep (conv_impl x pad_channels x remat_policy x "
-                    "meta_accum_steps) with bench.py, rank by measured "
-                    "step time cross-checked against the static roofline, "
-                    "and write the device-kind-keyed tuning table that "
-                    "config 'auto' resolution consults",
+                    "meta_accum_steps x bn_stats_impl x pool_impl) with "
+                    "bench.py, rank by measured step time cross-checked "
+                    "against the static roofline, and write the "
+                    "device-kind-keyed tuning table that config 'auto' "
+                    "resolution consults",
     )
     parser.add_argument("--fast", action="store_true",
                         help="2-point smoke sweep on a tiny workload (the "

@@ -53,11 +53,15 @@ SPMD_CONTRACT_NAMES = ("sharding", "collective_census", "hbm_budget",
 #: op classes that distinguish a healthy lowering from a regressed one —
 #: the census the baseline pins and the regression check compares (the full
 #: census would drown the signal in elementwise noise). Shared with
-#: bench.py's ``hlo_cost`` field.
+#: bench.py's ``hlo_cost`` field. ``reduce``/``reduce-window`` joined in
+#: PR 16: the inner-loop compute diet (fused BN statistics, reshape pool,
+#: invariant im2col hoisting) exists to SHRINK them, so the baseline pins
+#: the reduction and a lever regression (an extra statistics pass per BN,
+#: the pool falling back to select-and-scatter) shows up as census growth.
 INTERESTING_OPS = (
     "dot", "convolution", "fusion", "custom-call", "all-reduce",
     "all-gather", "reduce-scatter", "copy", "transpose", "pad",
-    "gather", "scatter", "while",
+    "gather", "scatter", "while", "reduce", "reduce-window",
 )
 
 #: scalar cost_analysis keys surfaced whole by ``hlo_cost_breakdown``

@@ -171,8 +171,36 @@ class MAMLConfig:
     # an elementwise mask (~10x faster than select-and-scatter on CPU);
     # 'reduce_window' = XLA's native window reduce — on TPU the reshape
     # form's (.., 2, .., 2, ..) intermediate pads 3.4x in HBM tiles and
-    # OOMs the no-remat path; 'auto' = reshape on CPU, reduce_window else
+    # OOMs the no-remat path; 'auto' = the tuning table's measured winner
+    # for this device kind, else reshape on CPU, reduce_window elsewhere.
+    # Both are bit-exact VALID pools (trailing odd rows/cols sliced off
+    # before the reshape, so odd feature maps are handled identically);
+    # geometry that VANISHES under pooling (a stage's pool input smaller
+    # than the 2x2 window) is rejected at config build, not at trace time
     pool_impl: str = "auto"
+    # batch-norm statistics pass (ops.functional.batch_norm stats_impl):
+    # 'twopass' = separate mean + variance reductions over the conv output
+    # (the historical bit-pinned form); 'fused' = ONE concatenated
+    # sum/sum-of-squares reduction with f32 accumulation riding the
+    # conv_bn_act epilogue — halves the BN statistics passes per inner
+    # step (forward AND remat backward) at a pinned ULP tolerance
+    # (reassociation + E[x^2]-E[x]^2, tests/test_compute_diet.py);
+    # 'auto' = the tuning table's winner, else fused on CPU (where the
+    # scan-body reduction work dominates after the GEMM diet), twopass on
+    # accelerators (keeps the pinned TPU lowering until a sweep measures
+    # a win)
+    bn_stats_impl: str = "auto"
+    # invariant im2col hoisting: the support/target images are loop
+    # constants of the inner scan, so layer 1's patch extraction (the
+    # im2col over the largest spatial tensor) can be computed once per
+    # task outside the scan and threaded in as an invariant — bit-exact
+    # by construction (pure data movement; the hoisted tensor IS the
+    # inline value) while eliminating num_steps x re-extraction in the
+    # forward and the remat backward. 'auto' = on whenever it applies
+    # (patch-based conv lowering + conv-first block), 'on' forces it
+    # (rejected at config build when the lowering can never consume
+    # patches), 'off' keeps the self-contained per-step extraction
+    im2col_hoist: str = "auto"
     use_config_init_inner_lr: bool = False  # fix the task_learning_rate quirk
     # layout of incoming image batches: 'nchw' (the reference's torch layout,
     # data.py tensors are (..., c, h, w)), 'nhwc' (already TPU-native), or
@@ -552,6 +580,52 @@ class MAMLConfig:
                 f"pool_impl must be 'auto', 'reshape' or 'reduce_window', "
                 f"got {self.pool_impl!r}"
             )
+        if self.bn_stats_impl not in ("auto", "twopass", "fused"):
+            raise ValueError(
+                f"bn_stats_impl must be 'auto', 'twopass' or 'fused', got "
+                f"{self.bn_stats_impl!r}"
+            )
+        if self.im2col_hoist not in ("auto", "on", "off"):
+            raise ValueError(
+                f"im2col_hoist must be 'auto', 'on' or 'off', got "
+                f"{self.im2col_hoist!r}"
+            )
+        if self.im2col_hoist == "on" and self.conv_impl == "lax":
+            # the native conv consumes raw NHWC — there is no patch tensor
+            # to hoist; refuse the contradiction at config time instead of
+            # silently ignoring the forced knob at trace time
+            raise ValueError(
+                "im2col_hoist='on' requires a patch-based conv lowering "
+                "(conv_impl 'im2col', 'gemm' or 'auto'), got "
+                f"conv_impl={self.conv_impl!r}"
+            )
+        if self.im2col_hoist == "on" and self.block_order != "conv_norm_relu":
+            raise ValueError(
+                "im2col_hoist='on' requires block_order='conv_norm_relu': "
+                "the alternate block normalizes the conv INPUT with "
+                "adapted params, so layer 1's patches change every inner "
+                f"step and cannot be hoisted (got {self.block_order!r})"
+            )
+        if self.max_pooling:
+            # pool geometry is static — walk the stage dims (the same
+            # recurrence as models.vgg._stage_dims) and reject feature
+            # maps that VANISH under the 2x2/2 VALID pool at config time,
+            # not as a reshape/reduce_window trace error deep in the step
+            _h, _w = self.image_height, self.image_width
+            _pad = 1 if self.conv_padding else 0
+            for _stage in range(self.num_stages):
+                _ch, _cw = _h + 2 * _pad - 2, _w + 2 * _pad - 2
+                if _ch < 2 or _cw < 2:
+                    raise ValueError(
+                        f"max_pooling geometry vanishes at stage {_stage}: "
+                        f"the pool input is {_ch}x{_cw}, smaller than the "
+                        "2x2 window (VALID pooling would produce an empty "
+                        "feature map) — reduce num_stages or grow "
+                        f"image_height/image_width "
+                        f"({self.image_height}x{self.image_width}, "
+                        f"num_stages={self.num_stages})"
+                    )
+                _h, _w = _ch // 2, _cw // 2
         if self.steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
@@ -923,14 +997,58 @@ class MAMLConfig:
 
     @property
     def resolved_pool_impl(self) -> str:
-        """'auto' resolved against the live backend: the reshape pool's
-        mask gradient wins on CPU; reduce_window avoids the tile-padded
-        (.., 2, .., 2, ..) intermediate that bloats HBM on TPU."""
+        """'auto' resolved through the tuning table first (``cli tune``
+        sweeps pool_impl since PR 16), then the backend heuristic: the
+        reshape pool's mask gradient wins on CPU; reduce_window avoids
+        the tile-padded (.., 2, .., 2, ..) intermediate that bloats HBM
+        on TPU."""
         if self.pool_impl != "auto":
             return self.pool_impl
+        tuned = self._tuned("pool_impl")
+        if tuned in ("reshape", "reduce_window"):
+            return tuned
         import jax
 
         return "reshape" if jax.default_backend() == "cpu" else "reduce_window"
+
+    @property
+    def resolved_bn_stats_impl(self) -> str:
+        """'auto' resolved through the tuning table first (``cli tune``
+        sweeps bn_stats_impl since PR 16), then the backend heuristic:
+        'fused' on CPU — the inner scan's BN statistics reductions are
+        the top non-GEMM contributor in the roofline decomposition there,
+        and one concatenated sum/sum-of-squares pass halves them at a
+        pinned ULP tolerance — 'twopass' on accelerators (the bit-pinned
+        historical lowering stays the default until a sweep measures the
+        fused win on that hardware)."""
+        if self.bn_stats_impl != "auto":
+            return self.bn_stats_impl
+        tuned = self._tuned("bn_stats_impl")
+        if tuned in ("twopass", "fused"):
+            return tuned
+        import jax
+
+        return "fused" if jax.default_backend() == "cpu" else "twopass"
+
+    @property
+    def resolved_im2col_hoist(self) -> bool:
+        """Whether the inner loop hoists layer 1's patch extraction out of
+        the scan (``core.maml._task_learner`` / ``models.vgg
+        .layer1_patches``). 'on'/'off' are forced (the 'on' x 'lax' and
+        'on' x norm-first contradictions are rejected at config build);
+        'auto' enables it exactly when it applies — a patch-based conv
+        lowering (the hoisted tensor is what the conv would extract
+        inline, so this is bit-exact, strictly-less-work: no sweep axis
+        needed) and the conv-first block order (the alternate block's
+        conv input changes every inner step)."""
+        if self.im2col_hoist == "off":
+            return False
+        if self.im2col_hoist == "on":
+            return True
+        return (
+            self.block_order == "conv_norm_relu"
+            and self.resolved_conv_impl in ("im2col", "gemm")
+        )
 
     @property
     def global_tasks_per_batch(self) -> int:

@@ -169,12 +169,15 @@ def _task_learner(
     scan carry; returning it only keeps it from being DCE'd.
     """
 
-    def inner_step(frozen, lslr_params, x_s, y_s, x_t, y_t, carry, step):
+    def inner_step(
+        frozen, lslr_params, x_s, y_s, x_t, y_t, p_s, p_t, carry, step
+    ):
         theta, bn_st = carry
 
         def support_loss_fn(th):
             logits, new_bn = vgg.apply(
-                cfg, {**frozen, **th}, bn_st, x_s, step, training=True
+                cfg, {**frozen, **th}, bn_st, x_s, step, training=True,
+                x_patches=p_s,
             )
             return F.cross_entropy(logits, y_s), new_bn
 
@@ -207,7 +210,8 @@ def _task_learner(
         # target loss with the *updated* weights at BN index `step`
         # (few_shot_learning_system.py:233-244)
         t_logits, new_bn = vgg.apply(
-            cfg, {**frozen, **theta}, new_bn, x_t, step, training=True
+            cfg, {**frozen, **theta}, new_bn, x_t, step, training=True,
+            x_patches=p_t,
         )
         t_loss = F.cross_entropy(t_logits, y_t)
         return (theta, new_bn), (t_loss, t_logits, extras)
@@ -220,7 +224,21 @@ def _task_learner(
         y_s = y_s.reshape(-1)
         y_t = y_t.reshape(-1)
         adapted, frozen = partition.split_inner(cfg, net)
-        step_fn = partial(inner_step, frozen, lslr_params, x_s, y_s, x_t, y_t)
+        # invariant im2col hoisting (cfg.im2col_hoist): the support/target
+        # images are loop constants, so layer 1's patch extraction — the
+        # im2col over the largest spatial tensor — is computed ONCE here,
+        # outside the checkpointed scan body, and threaded in as a scan
+        # invariant (the same discipline as the resident FlatStore).  The
+        # hoisted tensors are bitwise the values the inline extraction
+        # would produce (pure data movement — models.vgg.layer1_patches),
+        # and as step_fn inputs they are saved residuals: the remat
+        # backward re-extracts nothing either.  None (hoist off or
+        # inapplicable) keeps the self-contained per-step program.
+        p_s = vgg.layer1_patches(cfg, x_s)
+        p_t = vgg.layer1_patches(cfg, x_t)
+        step_fn = partial(
+            inner_step, frozen, lslr_params, x_s, y_s, x_t, y_t, p_s, p_t
+        )
         if cfg.use_remat:
             if cfg.remat_policy == "save_conv":
                 # keep the conv outputs (named in ops.functional.conv2d),

@@ -138,6 +138,38 @@ def init(cfg: MAMLConfig, key: jax.Array) -> Tuple[Params, BNState]:
     return params, bn_state
 
 
+def layer1_patches(cfg: MAMLConfig, x: jnp.ndarray):
+    """The stage-0 conv's patch tensor for raw images ``x`` — the hoistable
+    invariant of the MAML inner loop (``core.maml._task_learner`` computes
+    it ONCE per task outside the scan and threads it into every
+    ``apply(..., x_patches=...)`` call, so layer 1's im2col over the
+    largest spatial tensor is not re-extracted ``num_steps``x in the
+    forward and the remat backward).
+
+    Returns None when hoisting is inapplicable — the resolved conv
+    lowering consumes raw NHWC (``'lax'``), or the block normalizes its
+    INPUT with adapted params (``block_order='norm_conv_relu'``: the conv
+    input changes every inner step, so there is no invariant to hoist) —
+    letting callers thread the result through unconditionally.  When a
+    tensor is returned it is bitwise the value the inline extraction
+    would produce (``ops.functional.conv_patches``), so consuming it is
+    bit-exact by construction.
+    """
+    if not cfg.resolved_im2col_hoist:
+        return None
+    if cfg.block_order != "conv_norm_relu":
+        return None
+    if cfg.resolved_conv_impl not in ("im2col", "gemm"):
+        return None
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    stride = 1 if cfg.max_pooling else 2
+    pad = 1 if cfg.conv_padding else 0
+    return F.conv_patches(
+        x.astype(dtype), 3, 3, stride, pad,
+        pad_channels=cfg.resolved_pad_channels,
+    )
+
+
 def apply(
     cfg: MAMLConfig,
     params: Params,
@@ -145,6 +177,7 @@ def apply(
     x: jnp.ndarray,
     num_step,
     training: bool = True,
+    x_patches=None,
 ) -> Tuple[jnp.ndarray, BNState]:
     """Forward pass.
 
@@ -156,6 +189,10 @@ def apply(
     :param training: only affects whether updated BN running stats are
         *returned*; normalization always uses batch stats, exactly like the
         reference's ``training=True`` call (meta_...py:246-247).
+    :param x_patches: optional pre-extracted stage-0 patch tensor
+        (``layer1_patches(cfg, x)``) — consumed by the first conv instead
+        of re-running im2col on ``x``; bit-exact with the inline
+        extraction. None keeps the self-contained forward.
     :return: (logits (batch, way), new_bn_state).
     """
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
@@ -203,7 +240,10 @@ def apply(
     def apply_norm(out, i):
         if cfg.norm_layer == "batch_norm":
             gamma, beta, rm, rv = bn_inputs(i)
-            out, nm, nv = F.batch_norm(out, gamma, beta, rm, rv)
+            out, nm, nv = F.batch_norm(
+                out, gamma, beta, rm, rv,
+                stats_impl=cfg.resolved_bn_stats_impl,
+            )
             store_bn(i, nm, nv)
         else:
             out = F.layer_norm(
@@ -220,8 +260,13 @@ def apply(
     # identical to the unfused sequence). The alternate block order and
     # layer_norm keep the unfused path.
     fused_block = conv_first and cfg.norm_layer == "batch_norm"
+    bn_stats = cfg.resolved_bn_stats_impl
 
     for i in range(cfg.num_stages):
+        # the hoisted stage-0 patches are only valid for the conv-first
+        # block (its conv input IS the raw image; the alternate block
+        # normalizes the input with adapted params first)
+        patches = x_patches if (i == 0 and conv_first) else None
         if not conv_first:  # alternate block: norm the INPUT (meta_...py:527-533)
             out = apply_norm(out, i)
         if fused_block:
@@ -235,6 +280,8 @@ def apply(
                 padding=pad,
                 impl=cfg.resolved_conv_impl,
                 pad_channels=pad_ch,
+                bn_stats_impl=bn_stats,
+                patches=patches,
             )
             store_bn(i, nm, nv)
         else:
@@ -246,6 +293,7 @@ def apply(
                 padding=pad,
                 impl=cfg.resolved_conv_impl,
                 pad_channels=pad_ch,
+                patches=patches,
             )
             if conv_first:
                 out = apply_norm(out, i)

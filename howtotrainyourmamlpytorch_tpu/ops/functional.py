@@ -109,6 +109,36 @@ def _im2col(
     return jnp.concatenate(cols, axis=-1)
 
 
+def conv_patches(
+    x: jnp.ndarray,
+    kh: int,
+    kw: int,
+    stride: int,
+    padding: int,
+    pad_channels: Union[str, int] = "off",
+) -> jnp.ndarray:
+    """The patch tensor a patch-based conv lowering would extract from ``x``
+    — channel padding applied first, then ``_im2col`` — exposed so callers
+    can HOIST it out of a loop whose every iteration convolves the same
+    input (the MAML inner scan: support/target images are loop constants,
+    but layer 1 re-extracts their patches every inner step, forward AND
+    remat backward).
+
+    ``_im2col`` is pure data movement (pad + strided-slice + concat — no
+    arithmetic), so the hoisted tensor is the *identical value* the conv
+    would compute inline: threading it back through ``conv2d(...,
+    patches=...)`` / ``conv_bn_act(..., patches=...)`` is bit-exact by
+    construction at every derivative order.  Only meaningful for the
+    ``'im2col'``/``'gemm'`` lowerings (``'lax'`` consumes raw NHWC and
+    ignores no patches — callers gate on the resolved impl).
+    """
+    cin = x.shape[-1]
+    cin_p = pad_target(cin, pad_channels, x.dtype)
+    if cin_p != cin:
+        x = _pad_axis(x, -1, cin_p)
+    return _im2col(x, kh, kw, stride, padding)
+
+
 def conv2d(
     x: jnp.ndarray,
     w: jnp.ndarray,
@@ -117,6 +147,7 @@ def conv2d(
     padding: int,
     impl: str = "lax",
     pad_channels: Union[str, int] = "off",
+    patches: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """2-D convolution, NHWC x HWIO -> NHWC (ref: F.conv2d, meta_...py:89-97).
 
@@ -153,8 +184,13 @@ def conv2d(
     contraction and padded output channels are sliced off before the bias
     (and therefore before any norm layer), so results are bit-exact with the
     unpadded op while every GEMM dimension is lane/sublane aligned.
+
+    ``patches`` (optional) short-circuits patch extraction with a
+    pre-computed ``conv_patches(x, ...)`` tensor — the invariant-hoisting
+    hook (bit-exact: the hoisted tensor IS the value the inline extraction
+    would produce). Ignored by the ``'lax'`` lowering.
     """
-    out = _conv2d_raw(x, w, b, stride, padding, impl, pad_channels)
+    out = _conv2d_raw(x, w, b, stride, padding, impl, pad_channels, patches)
     # named for remat_policy='save_conv' (save_only_these_names); a no-op
     # unless a checkpoint policy references the name
     return checkpoint_name(out, "conv_out")
@@ -168,6 +204,7 @@ def _conv2d_raw(
     padding: int,
     impl: str,
     pad_channels: Union[str, int],
+    patches: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """``conv2d`` without the remat checkpoint name — the building block
     ``conv_bn_act`` composes so the save point can sit AFTER the fused
@@ -176,15 +213,18 @@ def _conv2d_raw(
     cin_p = pad_target(cin, pad_channels, x.dtype)
     cout_p = pad_target(cout, pad_channels, x.dtype)
     if cin_p != cin:
-        x = _pad_axis(x, -1, cin_p)
+        if patches is None:
+            x = _pad_axis(x, -1, cin_p)
         w = _pad_axis(w, 2, cin_p)
     if cout_p != cout:
         w = _pad_axis(w, 3, cout_p)
     if impl == "im2col":
-        patches = _im2col(x, kh, kw, stride, padding)
+        if patches is None:
+            patches = _im2col(x, kh, kw, stride, padding)
         out = patches @ w.astype(x.dtype).reshape(kh * kw * cin_p, cout_p)
     elif impl == "gemm":
-        patches = _im2col(x, kh, kw, stride, padding)
+        if patches is None:
+            patches = _im2col(x, kh, kw, stride, padding)
         n, ho, wo, k = patches.shape
         out = lax.dot_general(
             patches.reshape(n * ho * wo, k),
@@ -219,6 +259,8 @@ def conv_bn_act(
     impl: str = "lax",
     pad_channels: Union[str, int] = "off",
     negative_slope: float = 0.01,
+    bn_stats_impl: str = "twopass",
+    patches: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """The reference's used block (``MetaConvNormLayerReLU``) as ONE op:
     conv -> bias -> batch-norm (batch statistics + running-stat update) ->
@@ -237,10 +279,16 @@ def conv_bn_act(
     backward recomputes none of it. (``remat_policy='full'`` and the
     no-remat path are indifferent to the name — checkpoint_name is a
     no-op unless a policy references it.)
+
+    ``bn_stats_impl`` selects the statistics pass of the riding batch-norm
+    (``batch_norm``'s ``stats_impl``): ``'twopass'`` is the bit-pinned
+    separate mean/variance reduction, ``'fused'`` one concatenated
+    sum/sum-of-squares reduction (tolerance-bounded — see ``batch_norm``).
+    ``patches`` is the invariant-hoisting hook (see ``conv2d``).
     """
-    out = _conv2d_raw(x, w, b, stride, padding, impl, pad_channels)
+    out = _conv2d_raw(x, w, b, stride, padding, impl, pad_channels, patches)
     out, new_mean, new_var = batch_norm(
-        out, gamma, beta, running_mean, running_var
+        out, gamma, beta, running_mean, running_var, stats_impl=bn_stats_impl
     )
     out = jax.nn.leaky_relu(out, negative_slope=negative_slope)
     return checkpoint_name(out, "conv_out"), new_mean, new_var
@@ -325,6 +373,7 @@ def batch_norm(
     running_var: Optional[jnp.ndarray],
     momentum: float = 0.1,
     eps: float = 1e-5,
+    stats_impl: str = "twopass",
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """Batch norm over (N, H, W) per channel, NHWC.
 
@@ -334,12 +383,48 @@ def batch_norm(
     ``new = (1 - m) * old + m * batch``, with the *unbiased* batch variance
     feeding the running var) but never normalize anything.
 
+    ``stats_impl`` selects how the batch statistics are reduced:
+
+    * ``'twopass'`` — ``jnp.mean`` + ``jnp.var``: the variance pass
+      re-reads ``x`` to reduce squared deviations from the already-known
+      mean. Numerically the historical (bit-pinned) form.
+    * ``'fused'`` — sum and sum-of-squares reduced in ONE pass over the
+      conv output (f32 accumulation; XLA multi-output-fuses the two
+      same-shape reductions into a single read of ``x``), then
+      ``var = E[x^2] - E[x]^2`` (clamped at 0 against cancellation).
+      Where twopass reads ``x`` again to reduce squared deviations from
+      the already-known mean, the fused pass never revisits it — per
+      inner-loop step, forward AND remat backward — and the statistics
+      ride the ``conv_bn_act`` epilogue fusion (the train step's total
+      ``reduce`` census shrinks strictly, pinned by CONTRACTS.json and
+      the CI census-shrink gate). Tolerance-bounded vs twopass
+      (reassociation + the E[x^2]-E[x]^2 form; same proof standard as
+      the accum chained tails — the ULP bound is pinned in
+      tests/test_compute_diet.py for f32 and bf16 at both derivative
+      orders).
+
     Returns (y, new_running_mean, new_running_var); the stats are None-in
     None-out so batch-norm-without-tracking is the same code path.
     """
     reduce_axes = tuple(range(x.ndim - 1))  # all but channel
-    mean = jnp.mean(x, axis=reduce_axes)
-    var = jnp.var(x, axis=reduce_axes)
+    if stats_impl == "fused":
+        x32 = x.astype(jnp.float32)
+        n = 1
+        for ax in reduce_axes:
+            n *= x.shape[ax]
+        s1 = jnp.sum(x32, axis=reduce_axes)
+        s2 = jnp.sum(x32 * x32, axis=reduce_axes)
+        mean32 = s1 / n
+        var32 = jnp.maximum(s2 / n - mean32 * mean32, 0.0)
+        mean = mean32.astype(x.dtype)
+        var = var32.astype(x.dtype)
+    elif stats_impl == "twopass":
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+    else:
+        raise ValueError(
+            f"stats_impl must be 'twopass' or 'fused', got {stats_impl!r}"
+        )
     inv = lax.rsqrt(var + eps).astype(x.dtype)
     y = (x - mean.astype(x.dtype)) * inv
     y = y * gamma.astype(x.dtype) + beta.astype(x.dtype)

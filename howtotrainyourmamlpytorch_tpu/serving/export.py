@@ -116,6 +116,17 @@ def _manifest_expectation(cfg, ingest: str, cache: bool,
         "cache": bool(cache),
         "bucket_ladder": [int(b) for b in buckets],
         "shots_buckets": [int(s) for s in shots_buckets],
+        # RESOLVED kernel-lowering knobs, not the raw config values: the
+        # fingerprint above hashes 'auto', but 'auto' resolves through the
+        # mutable tuning table (TUNING.json) at trace time — a `cli tune`
+        # run that flips a winner changes the program an engine would
+        # compile TODAY, so an artifact exported before the flip must
+        # mismatch and fall back to compile, never load the stale lowering
+        "conv_impl": cfg.resolved_conv_impl,
+        "pad_channels": cfg.resolved_pad_channels,
+        "pool_impl": cfg.resolved_pool_impl,
+        "bn_stats_impl": cfg.resolved_bn_stats_impl,
+        "im2col_hoist": cfg.resolved_im2col_hoist,
     }
     # ingest-specific compatibility keys (e.g. the index ingest's resident
     # store row count — baked into the gather program's shapes)

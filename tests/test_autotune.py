@@ -198,10 +198,46 @@ def test_sweep_points_fast_and_full():
     fast = autotune.sweep_points(fast=True)
     assert len(fast) == 2
     full = autotune.sweep_points(fast=False)
-    # conv_impl x pad x remat x accum
-    assert len(full) == 3 * 2 * 2 * 3
+    # conv_impl x pad x remat x accum x bn_stats x pool (PR 16)
+    assert len(full) == 3 * 2 * 2 * 3 * 2 * 2
     for p in full + fast:
         assert set(p) == set(autotune.SWEEP_KNOBS)
+    # both fast points together cover both values of each diet axis, so
+    # the CI smoke exercises both lowerings
+    for knob in ("bn_stats_impl", "pool_impl"):
+        assert len({p[knob] for p in fast}) == 2
+
+
+def test_validate_diet_knobs_optional_but_checked():
+    """Pre-PR-16 tables (no bn_stats_impl/pool_impl) stay loadable —
+    `cli tune` output from an older checkout must not brick the consult —
+    but when present the values are validated like every other knob."""
+    autotune.validate_tuning_table(_valid_table())  # absent: fine
+    table = _valid_table()
+    table["entries"]["TPU v5 lite@bfloat16"].update(
+        bn_stats_impl="fused", pool_impl="reshape")
+    autotune.validate_tuning_table(table)  # present + valid: fine
+    for bad in ({"bn_stats_impl": "onepass"}, {"pool_impl": "stride"}):
+        table = _valid_table()
+        table["entries"]["TPU v5 lite@bfloat16"].update(bad)
+        with pytest.raises(ValueError):
+            autotune.validate_tuning_table(table)
+
+
+def test_build_table_records_diet_knobs():
+    rec = {
+        "value": 10.0, "device_kind": "cpu", "dtype": "float32",
+        "reduced": True, "backend": "cpu", "batch_size": 2, "mfu": None,
+        "bn_stats_impl": "fused", "pool_impl": "reshape",
+        "point": {"conv_impl": "im2col", "pad_channels": "off",
+                  "remat_policy": "full", "meta_accum_steps": 1,
+                  "bn_stats_impl": "fused", "pool_impl": "reshape"},
+    }
+    table = autotune.build_table([rec])
+    autotune.validate_tuning_table(table)
+    entry = table["entries"]["cpu@float32"]
+    assert entry["bn_stats_impl"] == "fused"
+    assert entry["pool_impl"] == "reshape"
 
 
 # -- config consult -----------------------------------------------------------

@@ -112,6 +112,11 @@ def test_bench_prints_parsable_json_line():
     # (CPU auto: im2col, padding off)
     assert rec["conv_impl"] == "im2col"
     assert rec["pad_channels"] == "off"
+    # the PR-16 compute-diet knobs are self-describing too (CPU auto:
+    # fused one-pass BN stats, reshape pool, hoisted layer-1 patches)
+    assert rec["bn_stats_impl"] == "fused"
+    assert rec["pool_impl"] == "reshape"
+    assert rec["im2col_hoist"] is True
     # donation/aliasing stats of the compiled step: the state is donated
     # and the executable aliases a non-trivial byte count in place
     don = rec["donation"]
@@ -168,6 +173,17 @@ def test_cpu_fallback_workload_is_pinned():
         "BENCH_NUMBER_OF_TRAINING_STEPS_PER_ITER": "3",
         "BENCH_USE_REMAT": "false",
     }
+
+
+def test_workload_knobs_include_diet_env():
+    """A diet-knob A/B run (BENCH_BN_STATS_IMPL etc.) is a sweep, not a
+    default-knob run: it must never refresh the longitudinal baseline."""
+    sys.path.insert(0, REPO)
+    import bench as bench_mod
+
+    for k in ("BENCH_BN_STATS_IMPL", "BENCH_IM2COL_HOIST",
+              "BENCH_POOL_IMPL"):
+        assert k in bench_mod._WORKLOAD_KNOBS
 
 
 def test_bench_flops_model_is_sane():

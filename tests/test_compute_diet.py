@@ -1,0 +1,549 @@
+"""The PR-16 inner-loop compute diet: three independently toggleable levers.
+
+* ``im2col_hoist`` — layer 1's patch extraction computed once per task
+  outside the inner ``lax.scan`` (``models.vgg.layer1_patches``) and
+  threaded as a scan invariant. Bit-exact by construction (the hoisted
+  tensor IS what the inline extraction would produce), pinned here with
+  ``assert_array_equal`` at both the forward and the meta-gradient level.
+* ``bn_stats_impl='fused'`` — one pass over the activations computing
+  sum + sum-of-squares in f32 instead of mean-then-var. Tolerance-
+  bounded, NOT bit-exact; the bounds pinned here (f32 and bf16, first
+  and second order) are ~5x above the measured deviation.
+* ``pool_impl='reshape'`` — already bit-exact at the op level
+  (test_conv_impl pins it); here the train-step-level equivalence.
+
+Plus the lever-off/on HLO census assertions (the fused stats must SHRINK
+the reduce census; the hoist must shrink the rolled scan's im2col ops),
+the config-time validation / 'auto' resolution rules, the tuning-table
+consult, the bench comparability invariant (diet knobs must not move
+``xla_flops_per_task`` — they cut time, not work), and the serving-export
+staleness key (a tuning-table flip of a resolved knob must invalidate
+AOT artifacts whose config fingerprint is unchanged).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import make_micro_cfg, make_synthetic_batch
+from numpy.testing import assert_array_equal
+
+from howtotrainyourmamlpytorch_tpu.analysis import autotune
+from howtotrainyourmamlpytorch_tpu.analysis.contracts import hlo_op_census
+from howtotrainyourmamlpytorch_tpu.core import maml, msl
+from howtotrainyourmamlpytorch_tpu.models import vgg
+from howtotrainyourmamlpytorch_tpu.ops import functional as F
+from howtotrainyourmamlpytorch_tpu.serving import export
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    ).astype(dtype)
+
+
+def _f32(x):
+    return np.asarray(x, dtype=np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuning_cache():
+    """Every test here sees (and leaves behind) a clean tuning-table
+    cache — several tests point MAML_TUNING_TABLE at temp files."""
+    autotune.clear_cache()
+    yield
+    autotune.clear_cache()
+
+
+# -- fused BN statistics: op level --------------------------------------------
+
+# Pinned deviation bounds for 'fused' vs 'twopass' — ~5x above measured
+# (f32 forward max |diff| ~2e-6 on these shapes; bf16 pays its 2^-8 eps
+# through the twopass arm's low-precision accumulation, the fused arm
+# accumulates in f32 either way).
+_BN_TOL = {
+    "float32": {"fwd": 1e-5, "grad": 1e-4, "grad2": 1e-3},
+    "bfloat16": {"fwd": 5e-2, "grad": 5e-2, "grad2": 1e-1},
+}
+
+
+def _bn_args(dtype):
+    x = _rand((8, 7, 9, 5), 0, dtype)
+    gamma = (_rand((5,), 1) * 0.1 + 1.0).astype(dtype)
+    beta = (_rand((5,), 2) * 0.1).astype(dtype)
+    rm = jnp.zeros((5,), dtype)
+    rv = jnp.ones((5,), dtype)
+    return x, gamma, beta, rm, rv
+
+
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+def test_fused_bn_forward_and_running_stats_within_bound(dtype_name):
+    dtype = jnp.dtype(dtype_name)
+    x, gamma, beta, rm, rv = _bn_args(dtype)
+    out_t, nm_t, nv_t = F.batch_norm(x, gamma, beta, rm, rv,
+                                     stats_impl="twopass")
+    out_f, nm_f, nv_f = F.batch_norm(x, gamma, beta, rm, rv,
+                                     stats_impl="fused")
+    assert out_f.dtype == out_t.dtype == dtype
+    tol = _BN_TOL[dtype_name]["fwd"]
+    np.testing.assert_allclose(_f32(out_f), _f32(out_t), atol=tol, rtol=tol)
+    np.testing.assert_allclose(_f32(nm_f), _f32(nm_t), atol=tol, rtol=tol)
+    np.testing.assert_allclose(_f32(nv_f), _f32(nv_t), atol=tol, rtol=tol)
+
+
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+def test_fused_bn_first_and_second_order_grads_within_bound(dtype_name):
+    dtype = jnp.dtype(dtype_name)
+    x, gamma, beta, _, _ = _bn_args(dtype)
+
+    def loss(impl):
+        def f(x, gamma, beta):
+            out, _, _ = F.batch_norm(x, gamma, beta, None, None,
+                                     stats_impl=impl)
+            return jnp.mean(jnp.tanh(out).astype(jnp.float32) ** 2)
+
+        return f
+
+    tol = _BN_TOL[dtype_name]
+    g_t = jax.grad(loss("twopass"), argnums=(0, 1, 2))(x, gamma, beta)
+    g_f = jax.grad(loss("fused"), argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(g_f, g_t):
+        np.testing.assert_allclose(_f32(a), _f32(b),
+                                   atol=tol["grad"], rtol=tol["grad"])
+
+    def meta(impl):
+        def m(x, gamma, beta):
+            g = jax.grad(loss(impl))(x, gamma, beta)
+            return jnp.sum(jnp.tanh(g.astype(jnp.float32)))
+
+        return m
+
+    gg_t = jax.grad(meta("twopass"))(x, gamma, beta)
+    gg_f = jax.grad(meta("fused"))(x, gamma, beta)
+    np.testing.assert_allclose(_f32(gg_f), _f32(gg_t),
+                               atol=tol["grad2"], rtol=tol["grad2"])
+
+
+def test_batch_norm_rejects_unknown_stats_impl():
+    x, gamma, beta, rm, rv = _bn_args(jnp.float32)
+    with pytest.raises(ValueError, match="stats_impl"):
+        F.batch_norm(x, gamma, beta, rm, rv, stats_impl="onepass")
+
+
+# -- hoisted layer-1 patches: forward level -----------------------------------
+
+
+def _apply_cfg(**overrides):
+    base = dict(conv_impl="im2col", max_pooling=True)
+    base.update(overrides)
+    return make_micro_cfg(**base)
+
+
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+@pytest.mark.parametrize("pad", ["off", "tile"])
+def test_hoisted_patches_forward_bit_exact(dtype_name, pad):
+    """``apply(..., x_patches=layer1_patches(...))`` must be bitwise the
+    self-contained forward — logits AND updated BN state."""
+    cfg = _apply_cfg(compute_dtype=dtype_name, pad_channels=pad,
+                     im2col_hoist="on")
+    params, bn = vgg.init(cfg, jax.random.PRNGKey(0))
+    x = _rand((8,) + cfg.im_shape, 3)
+    patches = vgg.layer1_patches(cfg, x)
+    assert patches is not None
+    out0, bn0 = vgg.apply(cfg, params, bn, x, 0, training=True)
+    out1, bn1 = vgg.apply(cfg, params, bn, x, 0, training=True,
+                          x_patches=patches)
+    assert_array_equal(np.asarray(out0), np.asarray(out1))
+    assert sorted(bn0) == sorted(bn1)
+    for k in bn0:
+        assert_array_equal(np.asarray(bn0[k]), np.asarray(bn1[k]))
+
+
+def test_layer1_patches_none_when_inapplicable():
+    """The hoist only exists for patch-consuming conv lowerings under the
+    conv-first block; everywhere else the helper says so with None."""
+    x = _rand((4, 8, 8, 1), 0)
+    assert vgg.layer1_patches(_apply_cfg(conv_impl="lax"), x) is None
+    assert vgg.layer1_patches(
+        _apply_cfg(block_order="norm_conv_relu"), x
+    ) is None
+    assert vgg.layer1_patches(_apply_cfg(im2col_hoist="off"), x) is None
+    assert vgg.layer1_patches(_apply_cfg(im2col_hoist="on"), x) is not None
+
+
+def test_conv_patches_matches_inline_extraction():
+    """conv2d(patches=conv_patches(x, ...)) == conv2d(x) bitwise, padded
+    and unpadded channels."""
+    x = _rand((3, 9, 9, 5), 0)
+    w = _rand((3, 3, 5, 7), 1)
+    b = _rand((7,), 2)
+    for pad_ch in ("off", "tile"):
+        for impl in ("im2col", "gemm"):
+            inline = F.conv2d(x, w, b, 2, 1, impl=impl, pad_channels=pad_ch)
+            patches = F.conv_patches(x, 3, 3, 2, 1, pad_channels=pad_ch)
+            hoisted = F.conv2d(x, w, b, 2, 1, impl=impl,
+                               pad_channels=pad_ch, patches=patches)
+            assert_array_equal(np.asarray(inline), np.asarray(hoisted))
+
+
+# -- train-step equivalence matrix (per lever) --------------------------------
+
+
+def _weights(cfg):
+    return msl.loss_weights_for(
+        cfg.number_of_training_steps_per_iter,
+        cfg.use_multi_step_loss_optimization, True, 0,
+        cfg.multi_step_loss_num_epochs,
+    )
+
+
+def _grads(cfg, second_order):
+    state = maml.init_state(cfg, seed=0)
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=1)
+    fn = jax.jit(maml.make_grads_fn(cfg, second_order))
+    loss, grads = fn(state, x_s, y_s, x_t, y_t, _weights(cfg))
+    return np.asarray(loss), jax.tree_util.tree_map(np.asarray, grads)
+
+
+def _assert_grads_close(ga, gb, atol, rtol):
+    la, ta = jax.tree_util.tree_flatten(ga)
+    lb, tb = jax.tree_util.tree_flatten(gb)
+    assert ta == tb
+    for a, b in zip(la, lb):
+        np.testing.assert_allclose(_f32(a), _f32(b), atol=atol, rtol=rtol)
+
+
+def _assert_grads_equal(ga, gb):
+    la, ta = jax.tree_util.tree_flatten(ga)
+    lb, tb = jax.tree_util.tree_flatten(gb)
+    assert ta == tb
+    for a, b in zip(la, lb):
+        assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize(
+    "second_order",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+)
+def test_hoist_meta_grads_bit_exact(second_order):
+    """The fast-lane hoist pin: meta-gradients with the scan-invariant
+    patch tensor threaded are BITWISE those of the inline extraction."""
+    off = make_micro_cfg(conv_impl="im2col", im2col_hoist="off",
+                         second_order=second_order)
+    on = off.replace(im2col_hoist="on")
+    loss_off, g_off = _grads(off, second_order)
+    loss_on, g_on = _grads(on, second_order)
+    assert_array_equal(loss_off, loss_on)
+    _assert_grads_equal(g_off, g_on)
+
+
+@pytest.mark.parametrize(
+    "second_order",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+)
+def test_fused_bn_meta_grads_within_bound(second_order):
+    tp = make_micro_cfg(bn_stats_impl="twopass", im2col_hoist="off")
+    fu = tp.replace(bn_stats_impl="fused")
+    loss_t, g_t = _grads(tp, second_order)
+    loss_f, g_f = _grads(fu, second_order)
+    np.testing.assert_allclose(loss_f, loss_t, atol=1e-5, rtol=1e-5)
+    _assert_grads_close(g_f, g_t, atol=1e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "second_order",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+)
+def test_reshape_pool_meta_grads_match_reduce_window(second_order):
+    rw = make_micro_cfg(max_pooling=True, pool_impl="reduce_window",
+                        im2col_hoist="off")
+    rs = rw.replace(pool_impl="reshape")
+    loss_a, g_a = _grads(rw, second_order)
+    loss_b, g_b = _grads(rs, second_order)
+    np.testing.assert_allclose(loss_b, loss_a, atol=1e-6, rtol=1e-6)
+    _assert_grads_close(g_b, g_a, atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+@pytest.mark.parametrize("second_order", [False, True])
+@pytest.mark.parametrize("pad", ["off", "tile"])
+@pytest.mark.parametrize("axis_mode", ["vmap", "map"])
+def test_diet_equivalence_matrix(dtype_name, second_order, pad, axis_mode):
+    """The full lever matrix: {f32,bf16} x {first,second order} x
+    {pad_channels off/tile} x {vmap,map}. All three levers flipped at
+    once against the all-off program — hoist and pool are bit-exact, so
+    the composite bound is the fused-BN bound alone."""
+    off = make_micro_cfg(
+        compute_dtype=dtype_name, pad_channels=pad,
+        task_axis_mode=axis_mode, conv_impl="im2col", max_pooling=True,
+        second_order=second_order,
+        bn_stats_impl="twopass", im2col_hoist="off",
+        pool_impl="reduce_window",
+    )
+    on = off.replace(bn_stats_impl="fused", im2col_hoist="on",
+                     pool_impl="reshape")
+    loss_off, g_off = _grads(off, second_order)
+    loss_on, g_on = _grads(on, second_order)
+    # micro-config meta-gradients are O(1); the absolute bound carries
+    tol = 1e-3 if dtype_name == "float32" else 1e-1
+    np.testing.assert_allclose(loss_on, loss_off, atol=tol, rtol=tol)
+    _assert_grads_close(g_on, g_off, atol=tol, rtol=tol)
+
+
+# -- HLO census: the diet must shrink the program -----------------------------
+
+
+def _census(cfg, second_order=True):
+    state = maml.init_state(cfg, seed=0)
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=0)
+    fn = jax.jit(maml.make_grads_fn(cfg, second_order))
+    txt = fn.lower(state, x_s, y_s, x_t, y_t,
+                   _weights(cfg)).compile().as_text()
+    return hlo_op_census(txt)
+
+
+@pytest.mark.slow
+def test_fused_bn_shrinks_reduce_census():
+    """The CI census-shrink gate's in-suite twin: the one-pass statistics
+    must lower to strictly fewer reduce ops on the second-order program."""
+    tp = make_micro_cfg(bn_stats_impl="twopass", im2col_hoist="off")
+    c_tp = _census(tp)
+    c_fu = _census(tp.replace(bn_stats_impl="fused"))
+    assert c_fu.get("reduce", 0) < c_tp.get("reduce", 0), (
+        f"fused={c_fu.get('reduce')} twopass={c_tp.get('reduce')}"
+    )
+
+
+@pytest.mark.slow
+def test_hoist_shrinks_rolled_remat_census():
+    """Where the hoist materially changes the program: a ROLLED inner
+    scan (num_steps > 8) under remat. On short unrolled scans XLA's CSE
+    already dedups the step-invariant extraction (the hoist is a no-op
+    by census there — still bit-exact), but remat re-extracts inside
+    every loop-body backward region; hoisting must strip those: strictly
+    fewer slice AND concatenate ops in the compiled train step."""
+    off = make_micro_cfg(conv_impl="im2col", im2col_hoist="off",
+                         number_of_training_steps_per_iter=10,
+                         use_remat=True)
+    on = off.replace(im2col_hoist="on")
+
+    def census_step(cfg):
+        state = maml.init_state(cfg, seed=0)
+        x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=0)
+        fn = jax.jit(maml.make_train_step(cfg, second_order=True),
+                     donate_argnums=(0,))
+        txt = fn.lower(state, x_s, y_s, x_t, y_t, _weights(cfg),
+                       jnp.float32(1e-3)).compile().as_text()
+        return hlo_op_census(txt)
+
+    c_off, c_on = census_step(off), census_step(on)
+    assert c_on.get("slice", 0) < c_off.get("slice", 0), (
+        f"hoisted slice={c_on.get('slice')} inline={c_off.get('slice')}"
+    )
+    assert c_on.get("concatenate", 0) < c_off.get("concatenate", 0)
+
+
+def _compiled_text(cfg, second_order=True):
+    state = maml.init_state(cfg, seed=0)
+    x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=0)
+    fn = jax.jit(maml.make_grads_fn(cfg, second_order))
+    return fn.lower(state, x_s, y_s, x_t, y_t,
+                    _weights(cfg)).compile().as_text()
+
+
+@pytest.mark.slow
+def test_reshape_pool_removes_reduce_window_census():
+    """The pool lever's census claim: 'reshape' lowers max-pooling with
+    zero pool-origin reduce-window ops.  The count does not drop to an
+    absolute zero on CPU because XLA lowers the MSL per-step scatter to
+    reduce-window too — those are pool-independent, so the honest
+    assertions are (a) strict shrink and (b) every residual
+    reduce-window in the reshape program traces to a scatter."""
+    rw = make_micro_cfg(max_pooling=True, pool_impl="reduce_window",
+                        im2col_hoist="off")
+    t_rw = _compiled_text(rw)
+    t_rs = _compiled_text(rw.replace(pool_impl="reshape"))
+    c_rw, c_rs = hlo_op_census(t_rw), hlo_op_census(t_rs)
+    assert c_rw.get("reduce-window", 0) > c_rs.get("reduce-window", 0), (
+        f"reduce_window={c_rw.get('reduce-window')} "
+        f"reshape={c_rs.get('reduce-window')}"
+    )
+    def pool_windows(t):
+        # pool-origin ops reduce a spatial 2x2 window; the scatter-lowered
+        # residuals reduce class-axis windows (e.g. size=1x32x2)
+        return [l for l in t.splitlines()
+                if "reduce-window(" in l and "x2x2x" in l]
+
+    assert pool_windows(t_rw), "reduce_window arm lost its pool ops?"
+    assert not pool_windows(t_rs), (
+        f"pool-origin reduce-window survived:\n{pool_windows(t_rs)}"
+    )
+
+
+@pytest.mark.slow
+def test_diet_knobs_preserve_xla_flops():
+    """The bench comparability invariant: the levers cut TIME, not WORK —
+    XLA's own flop count for the compiled step must agree within 5%
+    across the diet matrix (the bench.py cross-baseline assertion's
+    in-suite twin)."""
+    # a GEMM-dominated geometry, like every real workload this invariant
+    # guards (on reduction-dominated toy shapes the removed BN/pool
+    # bookkeeping is itself a visible flop fraction)
+    off = make_micro_cfg(conv_impl="im2col", max_pooling=True,
+                         image_height=16, image_width=16,
+                         cnn_num_filters=8, num_stages=2,
+                         bn_stats_impl="twopass", im2col_hoist="off",
+                         pool_impl="reduce_window")
+    on = off.replace(bn_stats_impl="fused", im2col_hoist="on",
+                     pool_impl="reshape")
+
+    def flops(cfg):
+        state = maml.init_state(cfg, seed=0)
+        x_s, y_s, x_t, y_t = make_synthetic_batch(cfg, seed=0)
+        fn = jax.jit(maml.make_grads_fn(cfg, True))
+        cost = fn.lower(state, x_s, y_s, x_t, y_t,
+                        _weights(cfg)).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+
+    f_off, f_on = flops(off), flops(on)
+    assert f_off > 0 and f_on > 0
+    assert abs(f_on / f_off - 1.0) < 0.05, (f_off, f_on)
+
+
+# -- config validation + 'auto' resolution ------------------------------------
+
+
+def test_config_rejects_invalid_diet_knob_values():
+    with pytest.raises(ValueError, match="bn_stats_impl"):
+        make_micro_cfg(bn_stats_impl="onepass")
+    with pytest.raises(ValueError, match="im2col_hoist"):
+        make_micro_cfg(im2col_hoist="yes")
+    with pytest.raises(ValueError, match="pool_impl"):
+        make_micro_cfg(pool_impl="stride")
+
+
+def test_config_rejects_contradictory_hoist_combos():
+    """'on' is a promise the lowering consumes patches; combinations
+    where it cannot are config-build errors, not silent no-ops."""
+    with pytest.raises(ValueError, match="im2col_hoist"):
+        make_micro_cfg(im2col_hoist="on", conv_impl="lax")
+    with pytest.raises(ValueError, match="im2col_hoist"):
+        make_micro_cfg(im2col_hoist="on", block_order="norm_conv_relu")
+    # 'auto' with the same combos is fine: it resolves to off
+    assert make_micro_cfg(conv_impl="lax").resolved_im2col_hoist is False
+    assert make_micro_cfg(
+        block_order="norm_conv_relu"
+    ).resolved_im2col_hoist is False
+
+
+def test_config_rejects_vanishing_pool_geometry():
+    """max_pooling halves each stage; a geometry whose pool input drops
+    below the 2x2 window is rejected at build, naming the stage."""
+    with pytest.raises(ValueError, match="geometry vanishes"):
+        make_micro_cfg(max_pooling=True, conv_padding=False,
+                       image_height=14, image_width=14, num_stages=3)
+    # one fewer stage is legal
+    make_micro_cfg(max_pooling=True, conv_padding=False,
+                   image_height=14, image_width=14, num_stages=2)
+
+
+def test_resolved_diet_knobs_cpu_heuristics():
+    cfg = make_micro_cfg()
+    # explicit beats everything
+    assert cfg.replace(
+        bn_stats_impl="twopass"
+    ).resolved_bn_stats_impl == "twopass"
+    assert cfg.replace(im2col_hoist="off").resolved_im2col_hoist is False
+    # CPU 'auto': fused stats, reshape pool, hoist on (im2col conv)
+    assert cfg.resolved_bn_stats_impl == "fused"
+    assert cfg.resolved_pool_impl == "reshape"
+    assert cfg.replace(conv_impl="im2col").resolved_im2col_hoist is True
+
+
+def _diet_table(tmp_path, name="diet.json", **knobs):
+    kind = jax.devices()[0].device_kind
+    entry = {
+        "conv_impl": "im2col", "pad_channels": "off",
+        "remat_policy": "full", "meta_accum_steps": 1,
+        "tasks_per_sec_per_chip": 10.0,
+    }
+    entry.update(knobs)
+    path = os.path.join(str(tmp_path), name)
+    with open(path, "w") as f:
+        json.dump({"version": autotune.TUNING_VERSION,
+                   "entries": {autotune.table_key(kind, "float32"): entry}},
+                  f)
+    autotune.clear_cache()
+    return path
+
+
+def test_auto_diet_knobs_consult_tuning_table(tmp_path, monkeypatch):
+    """A measured winner beats the heuristic: a table pinning the
+    non-CPU-default values flips both sweepable knobs. The hoist is NOT
+    table-consulted — it is strictly-less-work, no sweep axis."""
+    path = _diet_table(tmp_path, bn_stats_impl="twopass",
+                       pool_impl="reduce_window")
+    monkeypatch.setenv(autotune.TUNING_TABLE_ENV, path)
+    autotune.clear_cache()
+    cfg = make_micro_cfg()
+    assert cfg.resolved_bn_stats_impl == "twopass"
+    assert cfg.resolved_pool_impl == "reduce_window"
+    # explicit still beats the table
+    assert cfg.replace(
+        bn_stats_impl="fused"
+    ).resolved_bn_stats_impl == "fused"
+    assert cfg.replace(pool_impl="reshape").resolved_pool_impl == "reshape"
+    # a table without the PR-16 knobs (pre-PR-16 file) keeps heuristics
+    old = _diet_table(tmp_path, name="old.json")
+    monkeypatch.setenv(autotune.TUNING_TABLE_ENV, old)
+    autotune.clear_cache()
+    cfg = make_micro_cfg()
+    assert cfg.resolved_bn_stats_impl == "fused"
+    assert cfg.resolved_pool_impl == "reshape"
+
+
+# -- serving export: resolved knobs key the artifacts -------------------------
+
+
+def test_export_manifest_records_resolved_diet_knobs():
+    cfg = make_micro_cfg(max_pooling=True)
+    exp = export._manifest_expectation(cfg, "f32", False, [1], [1])
+    assert exp["bn_stats_impl"] == cfg.resolved_bn_stats_impl
+    assert exp["im2col_hoist"] == cfg.resolved_im2col_hoist
+    assert exp["pool_impl"] == cfg.resolved_pool_impl
+    assert exp["conv_impl"] == cfg.resolved_conv_impl
+
+
+def test_export_artifacts_stale_after_tuning_table_flip(
+    tmp_path, monkeypatch
+):
+    """THE staleness hole the manifest's resolved knobs close: the config
+    fingerprint hashes 'auto', so a `cli tune` run that flips a winner
+    leaves the artifact DIR valid while the program an engine would
+    compile today differs. Saved-then-flipped artifacts must refuse to
+    load (fall back to compile), never serve the stale lowering."""
+    cfg = make_micro_cfg()  # bn_stats_impl/pool_impl default 'auto'
+    compiled = jax.jit(lambda x: x * 2.0).lower(
+        jnp.zeros((2,), jnp.float32)
+    ).compile()
+    root = str(tmp_path)
+    export.save_artifacts(cfg, root, "f32", False, [1], [1],
+                          {"p": compiled})
+    loaded = export.load_artifacts(cfg, root, "f32", False, [1], [1])
+    assert loaded is not None and "p" in loaded
+    # flip the tuned winners; fingerprint (and artifact dir) unchanged
+    path = _diet_table(tmp_path, bn_stats_impl="twopass",
+                       pool_impl="reduce_window")
+    monkeypatch.setenv(autotune.TUNING_TABLE_ENV, path)
+    autotune.clear_cache()
+    assert export.artifact_dir_for(cfg, root, "f32", False) == \
+        export.artifact_dir_for(cfg, root, "f32", False)
+    assert export.load_artifacts(cfg, root, "f32", False, [1], [1]) is None
