@@ -28,6 +28,15 @@ on-demand device-profile windows):
     python -m howtotrainyourmamlpytorch_tpu.cli trace LOG
     python -m howtotrainyourmamlpytorch_tpu.cli trace LOG --out run.trace.json
 
+The ``slo`` subcommand (tools/slo_cli.py — stdlib plus the jax-free
+serving metrics module, also dispatched jax-free) is the offline SLO
+report: it replays a serving log's schema-v12 ``deadline`` records
+through the same tracker the live ``/metrics`` endpoint runs (miss
+rate, error budget, multi-window burn rates, per-replica misses) and
+cross-checks the log's end-of-run ``slo`` record against the replay:
+
+    python -m howtotrainyourmamlpytorch_tpu.cli slo LOG [--json]
+
 The ``lint`` subcommand (analysis/lint.py — pure stdlib, also dispatched
 jax-free) runs the repo-specific JAX-pitfall linter; the ``audit``
 subcommand (tools/audit_cli.py — needs jax) statically verifies the
@@ -42,18 +51,23 @@ HBM budget / roofline) with the family compiled under a real hybrid
     python -m howtotrainyourmamlpytorch_tpu.cli audit --mesh 1x8 [--pin]
 
 The ``serve-bench`` subcommand (serving/bench.py — needs jax) is the
-closed-loop load generator for the adapt-on-request serving engine: it
-drives mixed-bucket synthetic traffic through a ``ServingEngine`` under a
+load generator for the adapt-on-request serving engine: it drives
+mixed-bucket synthetic traffic through a ``ServingEngine`` under a
 strict retrace gate and prints one JSON line with adaptation-latency
 p50/p95, tenants/sec, per-dispatch H2D bytes and cache hit rate
-(optionally writing schema-v11 ``serving`` telemetry records with
+(optionally writing schema-v12 ``serving`` telemetry records with
 ``--telemetry PATH``; ``--ingest {f32,uint8,index}`` selects the ingest
 tier, ``--repeat-tenant-fraction`` mixes adapted-params-cache hits in,
 ``--export-dir`` warms from AOT artifacts, ``--replicas N`` drives an
 N-replica shared-nothing pool through the cache-affinity router — the
 line gains aggregate + per-replica throughput — and ``--rollover``
 exercises the zero-downtime checkpoint-rollover lifecycle mid-load,
-serving/replica.py + router.py + refresh.py). The ``serve-export``
+serving/replica.py + router.py + refresh.py). ``--arrival
+poisson|bursty|zipf --rate R`` switches it OPEN-LOOP (a fixed-seed
+arrival schedule submitted against the wall clock — the queueing-
+collapse regime the closed loop cannot produce) and ``--deadline-ms``
+arms per-request deadline accounting: deadline records in the log, an
+``slo`` block in the line, burn-rate gauges on ``--metrics-port``. The ``serve-export``
 subcommand (serving/export.py — needs jax) writes those artifacts: the
 warmed (bucket x shots) program ladder serialized to a versioned dir
 keyed by device-kind/dtype/config-fingerprint, which a later engine
@@ -155,6 +169,13 @@ def main(argv=None):
         from .tools.trace_cli import main as trace_main
 
         raise SystemExit(trace_main(args[1:]))
+    if args and args[0] == "slo":
+        # offline SLO report (tools/slo_cli.py — stdlib + the jax-free
+        # serving.metrics tracker): replays a log's deadline records
+        # into error-budget / burn-rate terms, dispatched jax-free
+        from .tools.slo_cli import main as slo_main
+
+        raise SystemExit(slo_main(args[1:]))
     if args and args[0] == "lint":
         # repo-specific JAX-pitfall linter: pure stdlib, jax-free
         from .analysis.lint import main as lint_main

@@ -448,6 +448,26 @@ class MAMLConfig:
     # the daemon polls the experiment checkpoint dir for a new snapshot
     # to pre-warm into the standby slot and swap in. Must be > 0.
     serving_rollover_poll_s: float = 5.0
+    # serving SLO (serving/metrics.py SLOTracker): the per-request
+    # latency objective in ms. > 0 arms deadline accounting — serve-bench
+    # stamps it as the default deadline_ms on every generated request
+    # (per-request deadlines override it), and the /metrics endpoint,
+    # the end-of-run `slo` telemetry record and `cli slo` all report
+    # slack/miss against it. 0 (default) disables SLO machinery; traffic
+    # without deadlines emits no deadline records either way.
+    serving_slo_target_ms: float = 0.0
+    # the availability objective: the fraction of deadline-carrying
+    # requests that must meet their deadline. The error budget is the
+    # 1 - availability remainder; burn rate = window miss rate over the
+    # error budget (1.0 spends the budget exactly at the objective
+    # rate). Must be in (0, 1).
+    serving_slo_availability: float = 0.99
+    # burn-rate windows in seconds (the multi-window alerting form:
+    # short windows catch fast burns, long ones slow leaks). Must be
+    # positive and strictly increasing.
+    serving_slo_burn_windows_s: List[float] = field(
+        default_factory=lambda: [60.0, 300.0, 3600.0]
+    )
 
     # --- static analysis (analysis/) --------------------------------------
     # program-contract audits + runtime retrace detection:
@@ -815,6 +835,48 @@ class MAMLConfig:
                 "serving_rollover_poll_s must be > 0 (how often the "
                 "refresh daemon polls the checkpoint dir for rollover), "
                 f"got {self.serving_rollover_poll_s!r}"
+            )
+        # SLO knobs (serving/metrics.py SLOTracker)
+        if not (
+            isinstance(self.serving_slo_target_ms, (int, float))
+            and not isinstance(self.serving_slo_target_ms, bool)
+            and self.serving_slo_target_ms >= 0
+        ):
+            raise ValueError(
+                "serving_slo_target_ms must be a number >= 0 (0 disables "
+                "deadline/SLO accounting), got "
+                f"{self.serving_slo_target_ms!r}"
+            )
+        self.serving_slo_target_ms = float(self.serving_slo_target_ms)
+        if not (
+            isinstance(self.serving_slo_availability, float)
+            and 0.0 < self.serving_slo_availability < 1.0
+        ):
+            raise ValueError(
+                "serving_slo_availability must be a float in (0, 1) — the "
+                "error budget is the 1 - availability remainder, so 0 and "
+                "1 are both degenerate — got "
+                f"{self.serving_slo_availability!r}"
+            )
+        windows = self.serving_slo_burn_windows_s
+        if isinstance(windows, list):
+            self.serving_slo_burn_windows_s = windows = [
+                float(w) if isinstance(w, int)
+                and not isinstance(w, bool) else w
+                for w in windows
+            ]
+        if (
+            not isinstance(windows, list)
+            or not windows
+            or not all(
+                isinstance(w, float) and w > 0 for w in windows
+            )
+            or any(a >= b for a, b in zip(windows, windows[1:]))
+        ):
+            raise ValueError(
+                "serving_slo_burn_windows_s must be a non-empty strictly "
+                "increasing list of positive seconds (the multi-window "
+                f"burn-rate alerting form), got {windows!r}"
             )
         if self.analysis_level not in ("off", "warn", "strict"):
             raise ValueError(

@@ -55,6 +55,12 @@ class AdaptRequest:
     query_x: np.ndarray
     query_y: Optional[np.ndarray] = None
     tenant_id: Optional[str] = None
+    #: per-request latency budget in ms, counted from ``submit()``.
+    #: None (default) opts out of deadline accounting; when set, the
+    #: micro-batcher emits one ``event='deadline'`` serving record for
+    #: this request — slack or miss, with the stage attribution
+    #: (queue/route/assemble/dispatch/sync) — at resolution.
+    deadline_ms: Optional[float] = None
 
     @property
     def shots(self) -> int:
@@ -81,6 +87,8 @@ class IndexRequest:
     query_idx: np.ndarray
     labeled: bool = True
     tenant_id: Optional[str] = None
+    #: see ``AdaptRequest.deadline_ms``
+    deadline_ms: Optional[float] = None
 
     @property
     def shots(self) -> int:
@@ -196,6 +204,12 @@ class _Pending:
     error: Optional[BaseException] = None
     span: Any = None
     queue_span: Any = None
+    #: absolute perf_counter() deadline (enqueued + request.deadline_ms)
+    #: — None when the request opted out of deadline accounting
+    deadline: Optional[float] = None
+    #: router decision time (ms) stamped by ReplicaRouter.submit — the
+    #: 'route' share of the deadline record's stage attribution
+    route_ms: float = 0.0
 
     def get(self, timeout: Optional[float] = None):
         """Block until the request was served; returns its
@@ -279,6 +293,13 @@ class MicroBatcher:
         # shape error
         self.engine._validate(request)
         pending = _Pending(request=request, enqueued=time.perf_counter())
+        deadline_ms = getattr(request, "deadline_ms", None)
+        if deadline_ms is not None:
+            if float(deadline_ms) <= 0:
+                raise ValueError(
+                    f"deadline_ms must be > 0, got {deadline_ms}"
+                )
+            pending.deadline = pending.enqueued + float(deadline_ms) / 1e3
         tracer = self.engine.tracer
         if tracer.enabled:
             # the request's causal root: request_id ties every stage of
@@ -452,8 +473,50 @@ class MicroBatcher:
                     tracer.end_span(
                         p.span, bucket=dr.bucket, outcome="served",
                     )
+                self._record_deadlines(group, now, dr=dr)
             except BaseException as e:  # noqa: BLE001 - relayed to callers
                 for p in group:
                     p.error = e
                     p.done.set()
                     tracer.end_span(p.span, outcome="error")
+                self._record_deadlines(group, now, failed=True)
+
+    def _record_deadlines(self, group: List[_Pending], dequeued: float,
+                          dr: Any = None, failed: bool = False) -> None:
+        """One ``event='deadline'`` serving record per deadline-carrying
+        request in the resolved group: slack (positive = met) or miss,
+        with the stage attribution — this request's own queue wait, its
+        router decision time, and the dispatch's assemble(batch)/
+        dispatch/sync decomposition. A FAILED dispatch counts as a miss
+        (the availability objective is over useful responses), flagged
+        ``failed`` so miss forensics can split overload from errors.
+        Requests without a deadline emit nothing — closed-loop traffic
+        is unchanged."""
+        record = getattr(self.engine, "_record", None)
+        if record is None:
+            return
+        resolved = time.perf_counter()
+        for p in group:
+            if p.deadline is None:
+                continue
+            slack_ms = (p.deadline - resolved) * 1e3
+            fields: Dict[str, Any] = dict(
+                event="deadline",
+                tenant_id=getattr(p.request, "tenant_id", None),
+                shots=p.request.shots,
+                deadline_ms=round(float(p.request.deadline_ms), 3),
+                slack_ms=round(slack_ms, 3),
+                missed=bool(failed or slack_ms < 0),
+                e2e_ms=round((resolved - p.enqueued) * 1e3, 3),
+                queue_ms=round((dequeued - p.enqueued) * 1e3, 3),
+                route_ms=round(p.route_ms, 3),
+            )
+            if failed:
+                fields["failed"] = True
+            if dr is not None:
+                fields.update(
+                    batch_ms=round(dr.batch_ms, 3),
+                    dispatch_ms=round(dr.dispatch_ms, 3),
+                    sync_ms=round(dr.sync_ms, 3),
+                )
+            record(**fields)

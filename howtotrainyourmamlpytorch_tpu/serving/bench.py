@@ -1,10 +1,31 @@
-"""``cli serve-bench`` — closed-loop load generator for the serving path.
+"""``cli serve-bench`` — load generator for the serving path.
 
 Drives a ``ServingEngine`` with synthetic adapt-on-request traffic that
 cycles through MIXED tenant-group sizes (1..max_tenants) and every
 configured shots bucket — the steady-state mixed-bucket pattern the
 zero-retrace contract must hold under (the engine's RetraceDetector runs
-strict: any mid-run recompile fails the bench). Prints ONE JSON line:
+strict: any mid-run recompile fails the bench).
+
+Two traffic disciplines:
+
+* **closed-loop** (default) — each dispatch group waits for the previous
+  one; the generator can never outpace the service, so it measures
+  service latency and peak throughput but CANNOT exhibit queueing
+  collapse (the queue never builds past one group);
+* **open-loop** (``--arrival poisson|bursty|zipf --rate R``) — a
+  fixed-seed arrival schedule is submitted against the WALL CLOCK into
+  the micro-batcher(s), whether or not the service keeps up. Above
+  capacity the backlog (and queue delay) grows without bound — the
+  queueing-collapse regime only an open-loop generator can produce.
+  ``--deadline-ms`` (default: the config's ``serving_slo_target_ms``
+  when > 0) stamps a per-request deadline: every response lands an
+  ``event='deadline'`` telemetry record (slack or miss, stage-
+  attributed), the run reports an ``slo`` block (miss rate, error
+  budget, multi-window burn rates — ``cli slo`` renders the same from
+  the JSONL log), and ``--metrics-port`` exposes the matching
+  deadline/burn-rate Prometheus families.
+
+Prints ONE JSON line:
 
 .. code-block:: json
 
@@ -166,6 +187,111 @@ def _synth_groups(cfg, shots_buckets, n_requests: int, cap: int,
     return groups
 
 
+def _arrival_schedule(args, n: int) -> List[float]:
+    """Fixed-seed OPEN-LOOP arrival offsets (seconds from run start).
+
+    ``poisson`` (and ``zipf``, which reuses Poisson timing under a
+    Zipf tenant-popularity law): exponential inter-arrival gaps at the
+    mean ``--rate``. ``bursty``: on/off-modulated Poisson — arrivals
+    run at 2x the mean rate during the ON half of each
+    ``--burst-period-s`` square wave and pause during the OFF half
+    (same average rate, periodic backlog spikes). The schedule is a
+    pure function of ``--seed``, so above/below-capacity comparisons
+    replay the identical arrival process."""
+    rng = np.random.RandomState(args.seed + 1)
+    rate = float(args.rate)
+    if args.arrival == "bursty":
+        # draw on "busy time" at 2x rate, then map busy time onto the
+        # wall clock by skipping every OFF half-period — arrivals land
+        # only inside ON windows, exactly Poisson-at-2x within them
+        gaps = rng.exponential(1.0 / (2.0 * rate), size=n)
+        busy = np.cumsum(gaps)
+        period = float(args.burst_period_s)
+        half = period / 2.0
+        return [float((t // half) * period + (t % half)) for t in busy]
+    gaps = rng.exponential(1.0 / rate, size=n)
+    return [float(t) for t in np.cumsum(gaps)]
+
+
+def _zipf_requests(cfg, shots_buckets, n_requests: int, args,
+                   ingest: str, store_rows: int) -> List:
+    """Zipf-tenant-popularity traffic: a fixed tenant pool whose
+    request frequencies follow ``P(rank k) ∝ k^-a`` — a head of hot
+    tenants that keeps hitting the adapted-params cache and a long
+    cold tail, the skew real multi-tenant serving sees. Reuses each
+    tenant's ORIGINAL request object, so repeats are exact
+    content-fingerprint matches (cache hits once first adapted)."""
+    rng = np.random.RandomState(args.seed)
+    pool_size = max(len(shots_buckets), min(n_requests, 4 + n_requests // 4))
+    tenant_pool = [
+        _synth_request(
+            cfg, rng, shots_buckets[i % len(shots_buckets)], ingest,
+            store_rows, tenant_id=f"tenant-{i}",
+        )
+        for i in range(pool_size)
+    ]
+    weights = np.arange(1, pool_size + 1, dtype=np.float64) ** (
+        -float(args.zipf_exponent)
+    )
+    weights /= weights.sum()
+    picks = rng.choice(pool_size, size=n_requests, p=weights)
+    return [tenant_pool[int(k)] for k in picks]
+
+
+def _drive_open_loop(submit, requests, offsets):
+    """Submit each request at its scheduled wall-clock offset, whether
+    or not the service has kept up — the arrival process is INDEPENDENT
+    of service time, so a saturated service accumulates backlog (the
+    queueing collapse a closed-loop driver can never produce).
+    ``submit`` only enqueues (micro-batcher semantics), so a slow
+    dispatch never stalls the generator. Returns the pending futures
+    plus the worst generator lateness (ms) — scheduling fidelity: how
+    far behind its own schedule the generator itself fell."""
+    t0 = time.perf_counter()
+    pendings = []
+    late_ms_max = 0.0
+    for req, off in zip(requests, offsets):
+        now = time.perf_counter() - t0
+        if off > now:
+            time.sleep(off - now)
+        else:
+            late_ms_max = max(late_ms_max, (now - off) * 1e3)
+        pendings.append(submit(req))
+    return pendings, late_ms_max
+
+
+def _bench_traffic(args, cfg, shots_buckets, n_requests, engine,
+                   ingest, deadline_ms):
+    """The bench traffic plan: dispatch groups (what the closed loop
+    serves), their flattened request stream (what the batcher paths
+    submit), and the open-loop arrival offsets (``None`` under
+    ``--arrival closed``). Stamps ``deadline_ms`` onto every request
+    when deadline accounting is armed."""
+    if args.arrival == "zipf":
+        requests = _zipf_requests(
+            cfg, shots_buckets, n_requests, args, ingest=ingest,
+            store_rows=engine._store_rows,
+        )
+        groups = [requests]  # zipf is open-loop only; groups unused
+    else:
+        groups = _synth_groups(
+            cfg, shots_buckets, n_requests, engine.max_tenants,
+            args.seed, ingest=ingest, store_rows=engine._store_rows,
+            repeat_fraction=args.repeat_tenant_fraction,
+        )
+        requests = [r for g in groups for r in g]
+    offsets = (
+        _arrival_schedule(args, len(requests))
+        if args.arrival != "closed" else None
+    )
+    if deadline_ms is not None:
+        # repeat-pool requests appear more than once; stamping the same
+        # budget twice is harmless (each SUBMISSION gets its own clock)
+        for r in requests:
+            r.deadline_ms = float(deadline_ms)
+    return groups, requests, offsets
+
+
 class _DeviceOccupancyShim:
     """CPU replica-emulation (``--emulate-device-ms``): proxy one
     replica's engine and hold its dispatch slot for a fixed extra
@@ -193,13 +319,29 @@ class _DeviceOccupancyShim:
     def __getattr__(self, name):
         return getattr(self._engine, name)
 
+    def __setattr__(self, name, value):
+        # attribute WRITES forward too (sans the shim's own state): the
+        # rollover swap hands the outgoing engine's watchdog to the
+        # standby via `standby.watchdog = dog`, and through a shimmed
+        # standby that assignment must land on the real engine whose
+        # dispatch heartbeat the watchdog reads
+        if name in ("_engine", "_hold_s"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._engine, name, value)
 
-def _drive_pool(args, cfg, pool, router, requests, state, sink):
-    """Drive the replica pool open-loop (and, under ``--rollover``,
-    roll a new checkpoint through it MID-LOAD). Returns
-    ``{"dropped_requests": n, "rollover": block-or-None}`` — the
-    zero-downtime acceptance surface: every submitted future must
-    resolve, and every swap must report zero XLA compiles."""
+
+def _drive_pool(args, cfg, pool, router, requests, state, sink,
+                offsets=None):
+    """Drive the replica pool (and, under ``--rollover``, roll a new
+    checkpoint through it MID-LOAD). ``offsets`` switches the
+    submission discipline: ``None`` submits the whole batch at once
+    (the saturating burst), a schedule submits each request at its
+    wall-clock arrival time (the open-loop generators). Returns
+    ``{"dropped_requests": n, "rollover": block-or-None,
+    "open_loop_late_ms_max": ms-or-None}`` — the zero-downtime
+    acceptance surface: every submitted future must resolve, and every
+    swap must report zero XLA compiles."""
     import shutil
     import tempfile
 
@@ -221,7 +363,13 @@ def _drive_pool(args, cfg, pool, router, requests, state, sink):
             pool, cfg, save_dir, poll_s=0.05, sink=sink
         )
         daemon.prime()
-    pendings = [router.submit(r) for r in requests]
+    open_late_ms = None
+    if offsets is None:
+        pendings = [router.submit(r) for r in requests]
+    else:
+        pendings, open_late_ms = _drive_open_loop(
+            router.submit, requests, offsets
+        )
     if daemon is not None:
         # write a NEW checkpoint while the pool serves the backlog,
         # then roll on a BACKGROUND thread while this thread keeps
@@ -281,15 +429,18 @@ def _drive_pool(args, cfg, pool, router, requests, state, sink):
             ),
         }
         shutil.rmtree(scratch, ignore_errors=True)
-    return {"dropped_requests": dropped, "rollover": block}
+    return {"dropped_requests": dropped, "rollover": block,
+            "open_loop_late_ms_max": open_late_ms}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="serve-bench",
-        description="Closed-loop load generator for the adapt-on-request "
-                    "serving engine (latency p50/p95, tenants/sec, "
-                    "zero-retrace gate)",
+        description="Load generator for the adapt-on-request serving "
+                    "engine: closed-loop (latency p50/p95, tenants/sec, "
+                    "zero-retrace gate) or open-loop (--arrival: "
+                    "Poisson/bursty/Zipf schedules, deadline + SLO "
+                    "accounting)",
     )
     parser.add_argument("--fast", action="store_true",
                         help="seconds-scale smoke workload (the CI gate)")
@@ -401,6 +552,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "core(s) and cannot scale, but the "
                              "occupancy window overlaps perfectly. "
                              "0 (default) disables the shim")
+    parser.add_argument("--arrival", default="closed",
+                        choices=["closed", "poisson", "bursty", "zipf"],
+                        help="traffic discipline: 'closed' (default) "
+                             "waits for each dispatch before the next — "
+                             "measures service latency but can never "
+                             "exhibit queueing collapse; the rest are "
+                             "OPEN-LOOP fixed-seed arrival schedules "
+                             "submitted against the wall clock "
+                             "(requires --rate): 'poisson' exponential "
+                             "inter-arrivals, 'bursty' on/off-modulated "
+                             "Poisson (2x rate during the ON half of "
+                             "each --burst-period-s), 'zipf' Poisson "
+                             "timing with Zipf tenant popularity "
+                             "(hot-head/cold-tail cache skew)")
+    parser.add_argument("--rate", type=float, default=None, metavar="R",
+                        help="mean arrival rate, requests/sec (open-loop "
+                             "arrivals only)")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        metavar="MS",
+                        help="per-request latency budget counted from "
+                             "submit: every response lands a telemetry "
+                             "deadline record (slack or miss) and the "
+                             "line gains an `slo` block (miss rate, "
+                             "error budget, burn rates). Default: the "
+                             "config's serving_slo_target_ms when > 0, "
+                             "else deadline accounting is off")
+    parser.add_argument("--burst-period-s", type=float, default=1.0,
+                        metavar="S",
+                        help="square-wave period for --arrival bursty "
+                             "(ON for the first half, OFF for the "
+                             "second; default 1.0)")
+    parser.add_argument("--zipf-exponent", type=float, default=1.2,
+                        metavar="A",
+                        help="popularity exponent for --arrival zipf: "
+                             "P(tenant rank k) ~ k^-A over the tenant "
+                             "pool (must be > 1; default 1.2)")
     args = parser.parse_args(argv)
     if args.trace and not args.telemetry:
         parser.error("--trace requires --telemetry: span records ride "
@@ -427,6 +614,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "device-occupancy shim emulates PER-REPLICA "
                      "device blocking; it has no meaning on the "
                      "single-engine closed loop)")
+    if args.arrival != "closed" and args.rate is None:
+        parser.error("--arrival poisson|bursty|zipf is OPEN-LOOP and "
+                     "needs its arrival process parameterized: pass "
+                     "--rate (mean requests/sec)")
+    if args.rate is not None and args.arrival == "closed":
+        parser.error("--rate has no meaning for the closed-loop "
+                     "generator (the service sets the pace); pick an "
+                     "open-loop --arrival")
+    if args.rate is not None and args.rate <= 0:
+        parser.error(f"--rate must be > 0, got {args.rate}")
+    if args.deadline_ms is not None and args.deadline_ms <= 0:
+        parser.error(f"--deadline-ms must be > 0, got {args.deadline_ms}")
+    if args.burst_period_s <= 0:
+        parser.error(f"--burst-period-s must be > 0, got "
+                     f"{args.burst_period_s}")
+    if args.zipf_exponent <= 1.0:
+        parser.error("--zipf-exponent must be > 1 (the popularity law "
+                     f"must be normalizable), got {args.zipf_exponent}")
+    if args.rollover and args.arrival != "closed":
+        parser.error("--rollover drives closed-loop live-traffic waves "
+                     "around the swap; combine it with the default "
+                     "--arrival closed (mid-run rollover under open "
+                     "loop is covered by the pool unit tests)")
     if args.replicas is not None:
         if args.replicas < 1:
             parser.error(f"--replicas must be >= 1, got {args.replicas}")
@@ -446,6 +656,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = _bench_cfg(args)
     n_requests = args.requests or (8 if args.fast else 64)
     shots_buckets = bench_shots_buckets(cfg)
+
+    # deadline accounting: the flag wins, else the config's SLO target
+    # doubles as the bench deadline. Deadline records are emitted by the
+    # micro-batcher at request resolution, so the single-engine CLOSED
+    # loop (which dispatches directly, no batcher) cannot account them —
+    # say so instead of silently reporting an empty SLO block.
+    deadline_ms = args.deadline_ms
+    if deadline_ms is None and cfg.serving_slo_target_ms > 0:
+        deadline_ms = float(cfg.serving_slo_target_ms)
+    if (deadline_ms is not None and args.arrival == "closed"
+            and args.replicas is None):
+        print("serve-bench: deadline accounting rides the micro-batcher "
+              "path; ignored on the single-engine closed loop (use an "
+              "open-loop --arrival or --replicas)",
+              file=sys.stderr, flush=True)
+        deadline_ms = None
+    slo = None
+    if deadline_ms is not None:
+        from .metrics import SLOTracker
+
+        slo = SLOTracker(
+            target_ms=deadline_ms,
+            availability=cfg.serving_slo_availability,
+            burn_windows_s=tuple(cfg.serving_slo_burn_windows_s),
+        )
 
     from ..core import maml
     from .batcher import serve_requests
@@ -474,8 +709,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         # exists, so /healthz can report pool readiness)
         from .metrics import FanoutSink, ServingMetrics
 
-        metrics = ServingMetrics()
+        metrics = ServingMetrics(slo=slo)
         sink = FanoutSink(sink, metrics) if sink is not None else metrics
+    elif slo is not None:
+        # no metrics registry: tee the SLO tracker into the record
+        # stream directly. Either way the tracker is wired EXACTLY once
+        # (inside the registry or as its own sink, never both), so the
+        # endpoint, the JSONL log, and the line's slo block count each
+        # deadline record once from the same stream.
+        from .metrics import FanoutSink
+
+        sink = FanoutSink(sink, slo) if sink is not None else slo
 
     tracer = None
     if args.trace:
@@ -513,6 +757,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     pool = None
     router = None
     pool_drive = None
+    open_late_ms = None
+    open_dropped = None
     if args.replicas is not None:
         # the multi-replica protocol: one full engine per disjoint
         # device, requests routed by cache affinity, OPEN-LOOP
@@ -525,15 +771,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         if profiler is not None:
             print("serve-bench: --profile-request applies to the "
                   "single-engine path; ignored under --replicas",
-                  file=sys.stderr, flush=True)
-        if cfg.watchdog_timeout_s > 0:
-            # the PR-14 watchdog wraps ONE engine's dispatch heartbeat;
-            # per-replica watchdogs (which must survive rollover engine
-            # swaps) are future work — say so instead of silently
-            # dropping the knob
-            print("serve-bench: watchdog_timeout_s applies to the "
-                  "single-engine path; NOT wired under --replicas "
-                  "(per-replica watchdogs are future work)",
                   file=sys.stderr, flush=True)
         import jax
 
@@ -553,6 +790,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             tracer=tracer, metrics=metrics, export_root=args.export_dir,
         )
         engine = pool.replicas[0].engine  # line metadata (shared knobs)
+        if cfg.watchdog_timeout_s > 0:
+            # one watchdog per replica, tagged with its replica_id;
+            # the pool rewires them across restart_replica and rollover
+            # engine swaps, and stops them in close()
+            pool.attach_watchdogs(cfg.watchdog_timeout_s, sink=sink)
         if args.metrics_port is not None:
             from .metrics import MetricsServer
 
@@ -587,14 +829,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             else max(cfg.serving_router_spill_depth, n_requests)
         )
         router = ReplicaRouter(pool, spill_depth=spill)
-        groups = _synth_groups(
-            cfg, shots_buckets, n_requests, engine.max_tenants,
-            args.seed, ingest=ingest, store_rows=engine._store_rows,
-            repeat_fraction=args.repeat_tenant_fraction,
+        groups, requests, offsets = _bench_traffic(
+            args, cfg, shots_buckets, n_requests, engine, ingest,
+            deadline_ms,
         )
-        requests = [r for g in groups for r in g]
         pool_drive = _drive_pool(args, cfg, pool, router, requests,
-                                 state, sink)
+                                 state, sink, offsets=offsets)
+        open_late_ms = pool_drive["open_loop_late_ms_max"]
         rollup = pool.rollup()
         pool.close()
     else:
@@ -621,13 +862,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         warmup_s = engine.warmup(artifact_dir=args.export_dir)
 
-        groups = _synth_groups(
-            cfg, shots_buckets, n_requests, engine.max_tenants, args.seed,
-            ingest=ingest, store_rows=engine._store_rows,
-            repeat_fraction=args.repeat_tenant_fraction,
+        groups, requests, offsets = _bench_traffic(
+            args, cfg, shots_buckets, n_requests, engine, ingest,
+            deadline_ms,
         )
-        for group in groups:
-            serve_requests(engine, group)
+        if offsets is not None:
+            # open loop on one engine: submit through a micro-batcher
+            # (the layer that owns queueing + deadline accounting) at
+            # the scheduled arrival times, then collect every future
+            from .batcher import MicroBatcher
+
+            batcher = MicroBatcher(engine, metrics=metrics)
+            pendings, open_late_ms = _drive_open_loop(
+                batcher.submit, requests, offsets
+            )
+            open_dropped = 0
+            for p in pendings:
+                try:
+                    p.get(timeout=600)
+                except Exception:  # noqa: BLE001 - counted, reported
+                    open_dropped += 1
+            batcher.close()
+        else:
+            for group in groups:
+                serve_requests(engine, group)
 
         rollup = engine.rollup()
     if profiler is not None:
@@ -636,6 +894,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         watchdog.stop()
     if metrics_server is not None:
         metrics_server.close()
+    if slo is not None and sink is not None:
+        # the run's SLO verdict as a first-class telemetry record — the
+        # same summary() the JSON line carries and `cli slo` recomputes
+        # from the log's deadline records
+        from ..telemetry.sinks import make_record
+
+        sink.write(make_record("slo", **slo.summary()))
     if sink is not None:
         sink.close()
     line = {
@@ -677,7 +942,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         "shots_buckets": list(engine.shots_buckets),
         "max_tenants_per_dispatch": engine.max_tenants,
         "fast": bool(args.fast),
+        # SLO observability surface: the traffic discipline, the
+        # per-request budget in force, how many dispatches aged out of
+        # the windowed percentile samples (the histograms above kept
+        # them), and — when deadlines were armed — the full SLO verdict
+        "arrival": args.arrival,
+        "rate": args.rate,
+        "deadline_ms": deadline_ms,
+        "window_dropped": rollup["window_dropped"],
     }
+    if open_late_ms is not None:
+        line["open_loop_late_ms_max"] = round(open_late_ms, 3)
+    if open_dropped is not None:
+        line["dropped_requests"] = open_dropped
+    if slo is not None:
+        line["slo"] = slo.summary()
     if pool is not None:
         # the pool surface: aggregate tenants_per_sec is total tenants
         # over the UNION wall-clock span (never a sum of per-replica

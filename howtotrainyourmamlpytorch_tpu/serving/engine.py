@@ -406,6 +406,22 @@ class ServingEngine:
         self._batch_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self._dispatch_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self._sync_ms: Deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        # log-bucketed histograms over the SAME stage samples — unlike
+        # the deques these never drop history (fixed ~129-bucket ladder,
+        # O(1) memory regardless of run length), merge exactly across
+        # replicas and engine swaps, and back the rollup's hist fields
+        # (schema v12). The windowed deques stay for the "current
+        # latency" percentiles; window_dropped in the rollup counts what
+        # they shed.
+        from .metrics import LogHistogram
+
+        self._hist: Dict[str, LogHistogram] = {
+            stage: LogHistogram()
+            for stage in (
+                "adapt_ms", "queue_ms", "batch_ms", "dispatch_ms",
+                "sync_ms",
+            )
+        }
         self._tenants_served = 0
         self._span_start: Optional[float] = None
         self._span_end: Optional[float] = None
@@ -1034,6 +1050,10 @@ class ServingEngine:
             self._batch_ms.append(batch_ms)
             self._dispatch_ms.append(dispatch_ms)
             self._sync_ms.append(sync_ms)
+            self._hist["adapt_ms"].observe(adapt_ms)
+            self._hist["batch_ms"].observe(batch_ms)
+            self._hist["dispatch_ms"].observe(dispatch_ms)
+            self._hist["sync_ms"].observe(sync_ms)
             fields = dict(
                 event="dispatch", tenants=len(idxs),
                 bucket=dispatch_bucket, shots=shots,
@@ -1079,6 +1099,7 @@ class ServingEngine:
             _fill(hit_idx, out, timings, args, "predict", b, batch_ms)
         self._span_end = time.perf_counter()
         self._queue_ms.append(float(queue_ms))
+        self._hist["queue_ms"].observe(float(queue_ms))
         self._tenants_served += n_real
         # combine the per-dispatch masked means, weighted by how many
         # LABELED tenants each dispatch carried (each mean is already
@@ -1134,6 +1155,12 @@ class ServingEngine:
             merged = list(getattr(old, name)) + list(dst)
             dst.clear()
             dst.extend(merged)  # deque maxlen keeps the window honest
+        # the log-bucketed histograms merge EXACTLY (no window, no
+        # truncation): the pool rollup's distribution survives the swap
+        # sample-for-sample, which is what makes pool-hist == merge of
+        # replica-hists hold across a mid-run rollover
+        for stage, hist in self._hist.items():
+            hist.merge(old._hist[stage])
         self.cache_hits += old.cache_hits
         self.cache_misses += old.cache_misses
         # the retrace history survives too: a pre-swap retrace must not
@@ -1221,13 +1248,28 @@ class ServingEngine:
                 round(self.cache_hits / lookups, 4)
                 if self.cache_size > 0 and lookups else None
             ),
+            # rollup honesty (schema v12): how many dispatch samples the
+            # bounded percentile window has shed — 0 means the windowed
+            # p50/p95 above cover the whole run, > 0 means they describe
+            # only the last LATENCY_WINDOW dispatches
+            "window_dropped": max(
+                0, self._hist["adapt_ms"].count - len(self._adapt_ms)
+            ),
+            # the full-history log-bucketed distributions (sparse bucket
+            # counts; serving/metrics.py LogHistogram.to_dict) — the
+            # mergeable, never-truncated complement to the windowed
+            # percentiles, and what the jax-free `cli slo`/inspect path
+            # recomputes quantiles from offline
+            "adapt_ms_hist": self._hist["adapt_ms"].to_dict(),
+            "queue_ms_hist": self._hist["queue_ms"].to_dict(),
         }
         self._record(event="rollup", **out)
         return out
 
 
 def attach_serving_watchdog(engine: "ServingEngine", timeout_s: float,
-                            sink=None, recorder=None):
+                            sink=None, recorder=None,
+                            replica_id: Optional[int] = None):
     """Wire the hang ``Watchdog`` to a serving engine and start it.
 
     The engine beats the watchdog once per device dispatch
@@ -1238,22 +1280,32 @@ def attach_serving_watchdog(engine: "ServingEngine", timeout_s: float,
     carrying the stage = the wedged dispatch site, all-thread stacks and
     the flight-recorder tail) and a flight-recorder incident directory
     (``recorder``, when given) surfaced as an ``incident`` record.
-    Returns the STARTED watchdog; callers own ``stop()``.
+    ``replica_id`` (the pooled shape, ``ReplicaSet.attach_watchdogs``)
+    tags the stall and incident records so a fleet's merged stream
+    attributes the stall to the wedged replica; default (None) keeps
+    single-engine records unchanged. Returns the STARTED watchdog;
+    callers own ``stop()``.
     """
     import sys as _sys
 
     from ..telemetry.sinks import make_record
     from ..telemetry.watchdog import Watchdog
 
+    if replica_id is None:
+        replica_id = getattr(engine, "replica_id", None)
+
     def on_stall(record):
+        tag = "" if replica_id is None else f" replica={replica_id}"
         print(
-            f"[serving-watchdog] no dispatch progress for "
+            f"[serving-watchdog{tag}] no dispatch progress for "
             f"{record['seconds_since_progress']:.1f}s "
             f"(stage={record['stage']!r}, beats={record['beat_count']})",
             file=_sys.stderr,
             flush=True,
         )
         context = {}
+        if replica_id is not None:
+            context["replica_id"] = replica_id
         if recorder is not None:
             context["recorder_tail"] = recorder.snapshot()[-8:]
         if sink is not None:
@@ -1278,9 +1330,12 @@ def attach_serving_watchdog(engine: "ServingEngine", timeout_s: float,
                       file=_sys.stderr, flush=True)
                 path = None
             if path is not None and sink is not None:
-                sink.write(make_record(
-                    "incident", iter=0, reason="watchdog_stall", path=path,
-                ))
+                incident = {
+                    "iter": 0, "reason": "watchdog_stall", "path": path,
+                }
+                if replica_id is not None:
+                    incident["replica_id"] = replica_id
+                sink.write(make_record("incident", **incident))
 
     watchdog = Watchdog(timeout_s, on_stall=on_stall)
     engine.watchdog = watchdog

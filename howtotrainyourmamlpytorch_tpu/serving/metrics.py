@@ -48,6 +48,7 @@ Pure stdlib — importable (and scrapeable) without jax or numpy.
 
 from __future__ import annotations
 
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
@@ -55,6 +56,8 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_MS",
     "Histogram",
+    "LogHistogram",
+    "SLOTracker",
     "ServingMetrics",
     "FanoutSink",
     "MetricsServer",
@@ -150,6 +153,376 @@ class Histogram:
         return lines
 
 
+#: LogHistogram ladder: 4 buckets per latency doubling (growth 2^0.25),
+#: floor 1e-3 ms — quantiles read off the ladder carry at most ~19%
+#: relative error, and the FIXED ladder is what makes two histograms
+#: mergeable bucket-by-bucket with no loss.
+LOG_HISTOGRAM_LOW_MS: float = 1e-3
+LOG_HISTOGRAM_GROWTH: float = 2.0 ** 0.25
+#: ladder length: bucket 128's upper bound is 1e-3 * 2^32 ms ≈ 71 min;
+#: anything slower lands in the single overflow bucket above it.
+LOG_HISTOGRAM_BUCKETS: int = 128
+
+
+class LogHistogram:
+    """A mergeable log-bucketed latency histogram (stdlib only).
+
+    Unlike the last-N sample windows the rollup percentiles used to be
+    quoted from, a histogram never drops history: every observation
+    lands in a bucket of the FIXED geometric ladder
+    ``low * growth**i``, so two histograms over the same ladder merge
+    EXACTLY (bucket-by-bucket count addition) — across replicas, and
+    across engine swaps via ``adopt_serving_history``. Quantiles are
+    read off the ladder as the upper bound of the bucket holding the
+    q-th observation (clamped to the observed min/max), so any quantile
+    is within one bucket's relative error (growth-1 ≈ 19%) of the true
+    sample quantile.
+
+    Counts are kept sparse (``{bucket_index: count}``) — a run whose
+    latencies span three decades touches ~40 of the 129 buckets — which
+    also keeps the ``to_dict`` payload embedded in rollup telemetry
+    records compact.
+    """
+
+    def __init__(
+        self,
+        low: float = LOG_HISTOGRAM_LOW_MS,
+        growth: float = LOG_HISTOGRAM_GROWTH,
+        n_buckets: int = LOG_HISTOGRAM_BUCKETS,
+    ):
+        if low <= 0 or growth <= 1 or n_buckets < 1:
+            raise ValueError(
+                f"bad ladder: low={low} growth={growth} n={n_buckets}"
+            )
+        self.low = float(low)
+        self.growth = float(growth)
+        self.n_buckets = int(n_buckets)
+        self._log_growth = math.log(self.growth)
+        self.counts: Dict[int, int] = {}
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    # -- ladder ------------------------------------------------------------
+
+    def bucket_index(self, value: float) -> int:
+        """Index of the bucket whose (lower, upper] interval holds
+        ``value``; 0 absorbs everything at or below the floor, index
+        ``n_buckets`` is the overflow bucket (upper bound +Inf)."""
+        if value <= self.low:
+            return 0
+        idx = int(math.ceil(math.log(value / self.low) / self._log_growth))
+        # float fuzz at an exact bound: log() can land a hair above the
+        # integer, pushing an on-the-bound value one bucket up — pull it
+        # back when the lower bound still covers the value
+        if idx > 0 and self.low * self.growth ** (idx - 1) >= value:
+            idx -= 1
+        return min(max(idx, 0), self.n_buckets)
+
+    def bucket_upper(self, index: int) -> float:
+        """Upper bound of bucket ``index`` (+Inf for the overflow)."""
+        if index >= self.n_buckets:
+            return float("inf")
+        return self.low * self.growth ** index
+
+    # -- observe / merge ---------------------------------------------------
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if math.isnan(v):
+            return
+        idx = self.bucket_index(v)
+        self.counts[idx] = self.counts.get(idx, 0) + 1
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def merge(self, other: "LogHistogram") -> None:
+        """Exact bucket-by-bucket merge — the pool/fleet rollup and the
+        rollover history-adoption path. Ladders must match (they are a
+        module constant; a mismatch means a version skew bug)."""
+        if (other.low, other.growth, other.n_buckets) != (
+            self.low, self.growth, self.n_buckets
+        ):
+            raise ValueError(
+                "cannot merge histograms over different ladders: "
+                f"({self.low}, {self.growth}, {self.n_buckets}) vs "
+                f"({other.low}, {other.growth}, {other.n_buckets})"
+            )
+        for idx, n in other.counts.items():
+            self.counts[idx] = self.counts.get(idx, 0) + n
+        self.count += other.count
+        self.total += other.total
+        for bound, pick in (("min", min), ("max", max)):
+            theirs = getattr(other, bound)
+            if theirs is not None:
+                ours = getattr(self, bound)
+                setattr(
+                    self, bound,
+                    theirs if ours is None else pick(ours, theirs),
+                )
+
+    # -- reading -----------------------------------------------------------
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The q-quantile (0..1) read off the ladder: the upper bound of
+        the bucket holding the ceil(q*count)-th observation, clamped to
+        the observed [min, max]. None when empty."""
+        if self.count == 0 or self.min is None or self.max is None:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        rank = max(1, math.ceil(q * self.count))
+        seen = 0
+        for idx in sorted(self.counts):
+            seen += self.counts[idx]
+            if seen >= rank:
+                return max(self.min, min(self.max, self.bucket_upper(idx)))
+        return self.max  # unreachable; counts always sum to count
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSONL-safe sparse form, embedded in rollup records so the
+        jax-free CLI can recompute the same quantiles offline."""
+        return {
+            "low": self.low,
+            "growth": self.growth,
+            "n_buckets": self.n_buckets,
+            "counts": {str(i): n for i, n in sorted(self.counts.items())},
+            "count": self.count,
+            "sum": round(self.total, 6),
+            "min": self.min,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_dict(cls, payload: Mapping[str, Any]) -> "LogHistogram":
+        hist = cls(
+            low=float(payload.get("low", LOG_HISTOGRAM_LOW_MS)),
+            growth=float(payload.get("growth", LOG_HISTOGRAM_GROWTH)),
+            n_buckets=int(payload.get("n_buckets", LOG_HISTOGRAM_BUCKETS)),
+        )
+        counts = payload.get("counts", {})
+        if isinstance(counts, Mapping):
+            for key, n in counts.items():
+                hist.counts[int(key)] = int(n)
+        hist.count = int(payload.get("count", sum(hist.counts.values())))
+        hist.total = float(payload.get("sum", 0.0))
+        for bound in ("min", "max"):
+            v = payload.get(bound)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                setattr(hist, bound, float(v))
+        return hist
+
+    def render(self, name: str, help_text: str) -> List[str]:
+        """Prometheus cumulative exposition: one ``_bucket`` line per
+        OCCUPIED ladder point (cumulative counts stay exact and monotone
+        over any subset of bounds) plus the mandatory ``+Inf``."""
+        lines = [
+            f"# HELP {name} {help_text}",
+            f"# TYPE {name} histogram",
+        ]
+        cumulative = 0
+        for idx in sorted(self.counts):
+            cumulative += self.counts[idx]
+            upper = self.bucket_upper(idx)
+            if upper != float("inf"):
+                lines.append(
+                    f'{name}_bucket{{le="{_fmt(round(upper, 9))}"}} '
+                    f"{cumulative}"
+                )
+        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum {_fmt(round(self.total, 6))}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
+
+
+class SLOTracker:
+    """Deadline/SLO accounting over the serving record stream.
+
+    Sink-compatible (``write(record)``): consumes the ``serving``
+    records with ``event="deadline"`` the micro-batcher emits once per
+    deadline-carrying request, and nothing else. Because it reads the
+    SAME record stream the JSONL log captures, a live ``/metrics``
+    scrape, the end-of-run ``slo`` telemetry record, and an offline
+    ``cli slo`` replay of the log all agree by construction.
+
+    The SLO itself: ``target_ms`` is the per-request latency objective
+    (a request whose deadline was missed burns budget), ``availability``
+    the objective fraction of requests that must meet it, and the error
+    budget the ``1 - availability`` remainder. Burn rate over a window
+    is the window's miss rate divided by the error budget — burn 1.0
+    spends the budget exactly at the objective rate, sustained burn
+    above 1.0 exhausts it early (the multi-window alerting form).
+    Windows are anchored to record timestamps (newest record = "now"),
+    so replaying a log yields the same numbers the live endpoint showed
+    at end of run.
+    """
+
+    def __init__(
+        self,
+        target_ms: float,
+        availability: float = 0.99,
+        burn_windows_s: Sequence[float] = (60.0, 300.0, 3600.0),
+    ):
+        if target_ms < 0:
+            raise ValueError(f"target_ms must be >= 0, got {target_ms}")
+        if not 0.0 < availability < 1.0:
+            raise ValueError(
+                f"availability must be in (0, 1), got {availability}"
+            )
+        windows = tuple(float(w) for w in burn_windows_s)
+        if not windows or any(w <= 0 for w in windows):
+            raise ValueError(
+                f"burn windows must be positive, got {burn_windows_s}"
+            )
+        self.target_ms = float(target_ms)
+        self.availability = float(availability)
+        self.error_budget = 1.0 - self.availability
+        self.burn_windows_s = tuple(sorted(windows))
+        self._lock = threading.Lock()
+        self.requests: Dict[str, int] = {}
+        self.missed: Dict[str, int] = {}
+        self._slack_ms = LogHistogram()  # |slack|; sign tracked by miss
+        # (ts, missed) per deadline record, pruned past the widest window
+        self._events: List[Tuple[float, bool]] = []
+        self._latest_ts: Optional[float] = None
+
+    # -- the sink face -----------------------------------------------------
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if (
+            not isinstance(record, dict)
+            or record.get("kind") != "serving"
+            or record.get("event") != "deadline"
+        ):
+            return
+        missed = bool(record.get("missed"))
+        label = _replica_label(record)
+        ts = record.get("ts")
+        with self._lock:
+            self._bump(self.requests, label)
+            if missed:
+                self._bump(self.missed, label)
+            slack = record.get("slack_ms")
+            if isinstance(slack, (int, float)) and not isinstance(
+                slack, bool
+            ):
+                self._slack_ms.observe(abs(float(slack)))
+            if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+                t = float(ts)
+                self._events.append((t, missed))
+                if self._latest_ts is None or t > self._latest_ts:
+                    self._latest_ts = t
+                self._prune_locked()
+
+    @staticmethod
+    def _bump(series: Dict[str, int], label: str) -> None:
+        series[label] = series.get(label, 0) + 1
+
+    def _prune_locked(self) -> None:
+        horizon = (self._latest_ts or 0.0) - max(self.burn_windows_s)
+        if self._events and self._events[0][0] <= horizon:
+            self._events = [e for e in self._events if e[0] > horizon]
+
+    def close(self) -> None:  # sink protocol completeness
+        pass
+
+    # -- reading -----------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """The SLO report: totals, per-replica breakdown, and per-window
+        burn rates — the payload of the ``slo`` telemetry record and of
+        ``cli slo``."""
+        with self._lock:
+            requests = sum(self.requests.values())
+            missed = sum(self.missed.values())
+            burn: Dict[str, Optional[float]] = {}
+            worst_w: Optional[float] = None
+            worst_rate: Optional[float] = None
+            now = self._latest_ts or 0.0
+            # windows are half-open (now - w, now]: a w-second window at
+            # one event/second holds exactly w events, not w + 1
+            for w in self.burn_windows_s:
+                in_window = [m for t, m in self._events if t > now - w]
+                if not in_window:
+                    burn[f"{w:g}"] = None
+                    continue
+                rate = (
+                    sum(1 for m in in_window if m) / len(in_window)
+                ) / self.error_budget
+                burn[f"{w:g}"] = round(rate, 6)
+                if worst_rate is None or rate > worst_rate:
+                    worst_rate, worst_w = rate, w
+            # series keys are Prometheus label blobs ('replica="0"' /
+            # ''); the summary reports bare replica ids ('0' / '-')
+            per_replica = {
+                (label[9:-1] if label else "-"): {
+                    "requests": n,
+                    "missed": self.missed.get(label, 0),
+                }
+                for label, n in sorted(self.requests.items())
+            }
+            return {
+                "target_ms": self.target_ms,
+                "availability": self.availability,
+                "error_budget": round(self.error_budget, 9),
+                "requests": requests,
+                "missed": missed,
+                "miss_rate": (
+                    round(missed / requests, 6) if requests else None
+                ),
+                "burn_rates": burn,
+                "worst_burn_window_s": worst_w,
+                "worst_burn_rate": (
+                    round(worst_rate, 6) if worst_rate is not None else None
+                ),
+                "per_replica": per_replica,
+            }
+
+    def render(self) -> List[str]:
+        """The Prometheus families ``ServingMetrics.render`` appends."""
+        with self._lock:
+            met = {
+                label: n - self.missed.get(label, 0)
+                for label, n in self.requests.items()
+            }
+            lines = _render_labeled(
+                "serving_deadline_met_total",
+                "Deadline-carrying requests that met their deadline",
+                "counter", met,
+            )
+            lines += _render_labeled(
+                "serving_deadline_missed_total",
+                "Deadline-carrying requests that missed their deadline",
+                "counter", dict(self.missed),
+            )
+            lines += [
+                "# HELP serving_slo_error_budget "
+                "Allowed deadline-miss fraction (1 - availability "
+                "objective)",
+                "# TYPE serving_slo_error_budget gauge",
+                f"serving_slo_error_budget {_fmt(self.error_budget)}",
+                "# HELP serving_slo_burn_rate Window deadline-miss rate "
+                "over the error budget (1.0 spends the budget exactly at "
+                "the objective rate)",
+                "# TYPE serving_slo_burn_rate gauge",
+            ]
+        summary_burn = self.summary()["burn_rates"]
+        for window, rate in summary_burn.items():
+            if rate is not None:
+                lines.append(
+                    f'serving_slo_burn_rate{{window_s="{window}"}} '
+                    f"{_fmt(rate)}"
+                )
+        return lines
+
+
 class ServingMetrics:
     """Aggregates ``serving`` telemetry records into scrapeable series.
 
@@ -159,12 +532,18 @@ class ServingMetrics:
     disagree. Thread-safe: dispatch threads (one per replica in a pool)
     write while the HTTP thread renders. Counters are keyed by the
     record's ``replica_id`` label ('' for unlabelled single-engine
-    records); the latency histograms stay pool-aggregate.
+    records); the latency histograms stay pool-aggregate (log-bucketed
+    ``LogHistogram`` families — no sample window, no silent drops).
+
+    Pass an ``SLOTracker`` to surface deadline/burn-rate families on the
+    same endpoint: ``write`` forwards every record to it (do NOT also
+    register the tracker as a separate fanout sink, or deadlines double-
+    count).
     """
 
-    def __init__(self,
-                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
+    def __init__(self, slo: Optional["SLOTracker"] = None):
         self._lock = threading.Lock()
+        self.slo = slo
         self.requests_total: Dict[str, int] = {}
         # (program, replica-label) -> dispatch count
         self.dispatches_by_program: Dict[Tuple[str, str], int] = {}
@@ -175,8 +554,8 @@ class ServingMetrics:
         self.warmups_total: Dict[str, int] = {}
         self.rollovers_total: Dict[str, int] = {}
         self.queue_depth: Dict[str, int] = {}
-        self.adapt_ms = Histogram(buckets)
-        self.queue_ms = Histogram(buckets)
+        self.adapt_ms = LogHistogram()
+        self.queue_ms = LogHistogram()
 
     @staticmethod
     def _bump(series: Dict[str, int], label: str, by: int) -> None:
@@ -187,6 +566,8 @@ class ServingMetrics:
     def write(self, record: Dict[str, Any]) -> None:
         """Consume one telemetry record (non-serving kinds pass through
         untouched — the tee carries the whole stream)."""
+        if self.slo is not None:
+            self.slo.write(record)
         if not isinstance(record, dict) or record.get("kind") != "serving":
             return
         event = record.get("event")
@@ -306,7 +687,10 @@ class ServingMetrics:
                 "serving_queue_latency_ms",
                 "Micro-batcher queue wait per dispatch",
             )
-            return "\n".join(lines) + "\n"
+        if self.slo is not None:
+            # outside self._lock: SLOTracker takes its own lock
+            lines += self.slo.render()
+        return "\n".join(lines) + "\n"
 
 
 class FanoutSink:
@@ -437,11 +821,73 @@ class MetricsServer:
         self._httpd.server_close()
 
 
+def _split_le(labels_blob: str) -> Tuple[Optional[str], str]:
+    """Split a ``_bucket`` series' label blob into its ``le`` value and
+    the remaining labels (the histogram's own labels, if any)."""
+    le: Optional[str] = None
+    rest: List[str] = []
+    for part in labels_blob.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith('le="') and part.endswith('"'):
+            le = part[len('le="'):-1]
+        else:
+            rest.append(part)
+    return le, ",".join(rest)
+
+
+def _validate_histograms(out: Dict[str, Dict[str, float]]) -> None:
+    """Histogram-exposition validation over parsed series: every
+    ``<base>_bucket`` family must carry parseable ``le`` labels, a
+    ``+Inf`` bucket, cumulative counts monotone in ``le`` order, and a
+    ``<base>_count``/``<base>_sum`` pair whose count equals the ``+Inf``
+    bucket. Raises ValueError naming the offending family."""
+    for name, series in out.items():
+        if not name.endswith("_bucket"):
+            continue
+        base = name[:-len("_bucket")]
+        # group buckets by the non-le labels (one group per histogram)
+        groups: Dict[str, List[Tuple[float, float]]] = {}
+        for labels_blob, value in series.items():
+            le, rest = _split_le(labels_blob)
+            if le is None:
+                raise ValueError(
+                    f"{name}{{{labels_blob}}} has no le label"
+                )
+            bound = float("inf") if le == "+Inf" else float(le)
+            groups.setdefault(rest, []).append((bound, value))
+        for rest, buckets in groups.items():
+            where = f"{base}{{{rest}}}" if rest else base
+            buckets.sort(key=lambda bv: bv[0])
+            if buckets[-1][0] != float("inf"):
+                raise ValueError(f"{where} histogram has no +Inf bucket")
+            cum = [v for _, v in buckets]
+            if any(b > a for b, a in zip(cum, cum[1:])):
+                raise ValueError(
+                    f"{where} histogram buckets are not cumulative "
+                    f"(non-monotone counts {cum})"
+                )
+            count = out.get(f"{base}_count", {}).get(rest)
+            if count is None:
+                raise ValueError(f"{where} histogram missing _count")
+            if count != buckets[-1][1]:
+                raise ValueError(
+                    f"{where} histogram _count {count} != +Inf bucket "
+                    f"{buckets[-1][1]}"
+                )
+            if f"{base}_sum" not in out or rest not in out[f"{base}_sum"]:
+                raise ValueError(f"{where} histogram missing _sum")
+
+
 def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
     """Parse exposition text into ``{metric_name: {labels_blob: value}}``
     (``labels_blob`` '' for unlabelled series). Used by the tests and the
-    CI trace-smoke job to assert the endpoint speaks valid text format —
-    a parse error raises ValueError naming the line."""
+    CI trace-smoke/slo-smoke jobs to assert the endpoint speaks valid
+    text format — a parse error raises ValueError naming the line, and
+    every ``*_bucket`` histogram family is validated for cumulative
+    monotone counts, a ``+Inf`` bucket, and a matching ``_count``/
+    ``_sum`` pair."""
     out: Dict[str, Dict[str, float]] = {}
     for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
@@ -461,4 +907,5 @@ def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
             raise ValueError(
                 f"prometheus text line {lineno} unparseable: {line!r} ({e})"
             ) from e
+    _validate_histograms(out)
     return out

@@ -148,6 +148,11 @@ class Replica:
     def _validate(self, req) -> int:
         return self.engine._validate(req)
 
+    def _record(self, **fields) -> None:
+        # proxied so the micro-batcher's deadline records flow through
+        # the engine's sink with this replica's replica_id tag
+        self.engine._record(**fields)
+
     def serve_group(self, requests, queue_ms: float = 0.0):
         # the swap lock is what makes checkpoint rollover dispatch-atomic:
         # swap_engine waits out an in-flight dispatch, and the next
@@ -265,6 +270,13 @@ class Replica:
             # every pre-swap dispatch from the pool rollup (both
             # engines are quiescent under the lock)
             standby.adopt_serving_history(old)
+            # the replica's stall watchdog survives the rollover: the
+            # standby beats the SAME watchdog the retired engine did, so
+            # a swap never leaves the replica unwatched (and never
+            # leaks a second monitor thread)
+            dog = getattr(old, "watchdog", None)
+            if dog is not None and getattr(standby, "watchdog", None) is None:
+                standby.watchdog = dog
             self.engine = standby
         swap_ms = (time.perf_counter() - start) * 1e3
         return {
@@ -378,6 +390,8 @@ class ReplicaSet:
             )
             for k in range(self.n_replicas)
         ]
+        self._watchdogs: Dict[int, Any] = {}
+        self._watchdog_cfg: Optional[Dict[str, Any]] = None
 
     def _build_engine(
         self, replica_id: int, state, snapshot_id: Optional[str]
@@ -408,6 +422,53 @@ class ReplicaSet:
                 artifact_dir=self.artifact_dir_for(r.replica_id)
             )
         return time.perf_counter() - start
+
+    # -- watchdogs ---------------------------------------------------------
+
+    def attach_watchdogs(self, timeout_s: float, sink=None,
+                         recorder=None) -> List[Any]:
+        """One stall watchdog PER replica (the single-engine
+        ``attach_serving_watchdog`` shape, pooled): each replica's
+        engine beats its own watchdog, so one wedged replica fires one
+        replica-tagged ``watchdog_stall`` record (+ flight-recorder
+        incident) while the rest of the pool keeps serving silently.
+        Watchdogs survive ``swap_engine`` rollovers (the standby
+        inherits the retired engine's watchdog under the swap lock) and
+        are re-attached automatically by ``restart_replica``. The pool
+        owns their lifecycle: ``close()`` stops them."""
+        from .engine import attach_serving_watchdog
+
+        self._watchdog_cfg = {
+            "timeout_s": float(timeout_s),
+            "sink": sink,
+            "recorder": recorder,
+        }
+        for r in self.replicas:
+            self._watchdogs[r.replica_id] = attach_serving_watchdog(
+                r.engine, timeout_s, sink=sink, recorder=recorder,
+                replica_id=r.replica_id,
+            )
+        return [self._watchdogs[r.replica_id] for r in self.replicas]
+
+    def _rewire_watchdog(self, replica: Replica) -> None:
+        """Move ``replica``'s watchdog slot onto its (fresh) engine —
+        the restart_replica half of watchdog continuity: the broken
+        replica's watchdog is stopped, a new one watches the
+        replacement."""
+        if self._watchdog_cfg is None:
+            return
+        from .engine import attach_serving_watchdog
+
+        old = self._watchdogs.pop(replica.replica_id, None)
+        if old is not None:
+            old.stop()
+        self._watchdogs[replica.replica_id] = attach_serving_watchdog(
+            replica.engine,
+            self._watchdog_cfg["timeout_s"],
+            sink=self._watchdog_cfg["sink"],
+            recorder=self._watchdog_cfg["recorder"],
+            replica_id=replica.replica_id,
+        )
 
     # -- standby / recovery ------------------------------------------------
 
@@ -440,6 +501,7 @@ class ReplicaSet:
             metrics=self.metrics,
         )
         self.replicas[replica_id] = fresh
+        self._rewire_watchdog(fresh)
         return fresh
 
     # -- pool surfaces -----------------------------------------------------
@@ -459,6 +521,8 @@ class ReplicaSet:
         lookups."""
         import numpy as np
 
+        from .metrics import LogHistogram
+
         per = []
         starts, ends = [], []
         adapt_samples: List[float] = []
@@ -468,6 +532,12 @@ class ReplicaSet:
         dispatch_samples: List[float] = []
         sync_samples: List[float] = []
         tenants = dispatches = retraces = hits = lookups = 0
+        window_dropped = 0
+        # the pool-level distributions: EXACT bucket-by-bucket merges of
+        # the per-replica log histograms (no sample window in the way)
+        pool_hist = {
+            "adapt_ms": LogHistogram(), "queue_ms": LogHistogram(),
+        }
         any_cache = False
         for r in self.replicas:
             eng = r.engine
@@ -477,6 +547,9 @@ class ReplicaSet:
             tenants += eng._tenants_served
             dispatches += ru["dispatches"]
             retraces += ru["retraces"]
+            window_dropped += ru["window_dropped"]
+            for stage, hist in pool_hist.items():
+                hist.merge(eng._hist[stage])
             adapt_samples.extend(eng._adapt_ms)
             queue_samples.extend(eng._queue_ms)
             h2d_samples.extend(eng._h2d_bytes)
@@ -538,8 +611,18 @@ class ReplicaSet:
             "cache_hit_rate": (
                 round(hits / lookups, 4) if any_cache and lookups else None
             ),
+            "window_dropped": window_dropped,
+            "adapt_ms_hist": pool_hist["adapt_ms"].to_dict(),
+            "queue_ms_hist": pool_hist["queue_ms"].to_dict(),
         }
 
     def close(self) -> None:
+        for dog in self._watchdogs.values():
+            dog.stop()
+        self._watchdogs.clear()
         for r in self.replicas:
+            # drop the engine's reference too — beats to a stopped dog
+            # are harmless but a dangling pointer invites double-stops
+            if getattr(r.engine, "watchdog", None) is not None:
+                r.engine.watchdog = None
             r.close()

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 
@@ -216,6 +217,7 @@ class ReplicaRouter:
         stats record only the decision that actually ENQUEUED, so
         ``routed_total`` always equals requests accepted (retried
         failed attempts are not double-counted)."""
+        route_start = time.perf_counter()
         for _ in range(len(self.replicas) + 1):
             target, kind = self._decide(request)
             try:
@@ -225,6 +227,10 @@ class ReplicaRouter:
                     raise  # a real submit error, not the trip race
                 continue
             self._record_route(target, kind)
+            # the 'route' share of the deadline record's stage
+            # attribution: decision time (health sweep + ring walk +
+            # any trip-race retries) ahead of the batcher enqueue
+            pending.route_ms = (time.perf_counter() - route_start) * 1e3
             return pending
         raise AllReplicasUnhealthyError(
             {r.replica_id: r.trip_cause for r in self.replicas}

@@ -92,7 +92,28 @@ Record kinds:
   multi-replica pool's merged stream stays per-replica attributable;
   single-engine records simply omit the field. The ``serving:`` line
   of ``cli inspect summary`` renders these jax-free, with a
-  per-replica breakdown when replica ids are present;
+  per-replica breakdown when replica ids are present. Since v12 two
+  more shapes: ``deadline`` (one deadline-carrying request resolved:
+  its ``deadline_ms`` budget, the signed ``slack_ms``, the ``missed``
+  verdict, end-to-end ``e2e_ms`` and the stage attribution —
+  per-request ``queue_ms``, router ``route_ms``, and the dispatch's
+  ``batch_ms`` / ``dispatch_ms`` / ``sync_ms``), and the rollup gains
+  ``window_dropped`` (how many dispatch samples the bounded percentile
+  window shed — rollup honesty) plus the mergeable log-bucketed
+  distributions ``adapt_ms_hist`` / ``queue_ms_hist``
+  (serving/metrics.py ``LogHistogram.to_dict``: sparse bucket counts
+  over a fixed geometric ladder, so offline consumers recompute the
+  same quantiles the live endpoint serves);
+* ``slo``            — the serving SLO report (schema v12,
+  serving/metrics.py ``SLOTracker.summary``): the ``target_ms`` /
+  ``availability`` objective and its ``error_budget``, total
+  deadline-carrying ``requests`` and ``missed`` counts, the
+  ``miss_rate``, per-window ``burn_rates`` (window miss rate over the
+  error budget; 1.0 spends the budget exactly at the objective rate)
+  with the worst window called out, and a ``per_replica`` breakdown.
+  Emitted by ``cli serve-bench`` at end of run; derived from the SAME
+  ``event='deadline'`` record stream the ``/metrics`` endpoint and
+  ``cli slo`` consume, so the three can never disagree;
 * ``analysis``       — the build-time program audit ran
   (``analysis_level != 'off'``): how many programs were audited (incl.
   the SPMD family on multi-device builds), how many contract violations
@@ -203,6 +224,20 @@ Version history / migration notes:
   (``tests/fixtures/telemetry_v10_schema.jsonl`` pins a v10-era log)
   and the forward-compat rules carry over (the future-schema fixture
   is re-pinned at v12-unknown).
+* **v12** — the serving SLO observability layer: adds the ``slo``
+  record kind (the deadline/burn-rate report — ``target_ms``,
+  ``availability``, ``requests``, ``missed``, per-window
+  ``burn_rates``), the ``serving`` ``event='deadline'`` shape (one
+  resolved deadline-carrying request: ``slack_ms`` / ``missed`` plus
+  the queue/route/batch/dispatch/sync stage attribution), and the
+  rollup's honesty/distribution fields (``window_dropped``,
+  ``adapt_ms_hist`` / ``queue_ms_hist`` — mergeable log-bucketed
+  histograms). Pure addition beyond the new kind (``serving`` still
+  requires only ``event``; ``slo`` requires ``target_ms`` /
+  ``requests`` / ``missed``): every v1..v11 record validates unchanged
+  (``tests/fixtures/telemetry_v11_schema.jsonl`` pins a v11-era log)
+  and the forward-compat rules carry over (the future-schema fixture
+  is re-pinned at v13-unknown).
 """
 
 from __future__ import annotations
@@ -210,7 +245,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -236,6 +271,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "analysis": ("programs", "violations"),
     "elastic": ("event",),
     "serving": ("event",),
+    "slo": ("target_ms", "requests", "missed"),
     "span": ("name", "cat", "trace_id", "span_id", "start_ms", "dur_ms"),
 }
 

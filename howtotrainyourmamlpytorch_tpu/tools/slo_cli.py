@@ -1,0 +1,243 @@
+"""``cli slo`` — offline SLO report from a serving telemetry log.
+
+Replays a JSONL log's ``serving``/``event="deadline"`` records (schema
+v12, emitted once per deadline-carrying request by the micro-batcher)
+through the SAME ``SLOTracker`` the live ``/metrics`` endpoint runs, so
+the offline report, the scrape, and the end-of-run ``slo`` telemetry
+record agree by construction — they are three renderings of one record
+stream:
+
+.. code-block:: console
+
+   python -m howtotrainyourmamlpytorch_tpu.cli slo LOG
+   python -m howtotrainyourmamlpytorch_tpu.cli slo LOG --json
+   python -m howtotrainyourmamlpytorch_tpu.cli slo LOG --target-ms 50
+
+The report: request/miss totals and miss rate, the error budget implied
+by the availability objective, burn rate per window (how many budgets
+per unit time the run was spending — 1.0 exhausts the budget exactly at
+the objective; the windows anchor to the NEWEST record's timestamp, so
+a replay reads the same "now" the live endpoint saw at shutdown), the
+worst window, and a per-replica breakdown. When the log carries an
+end-of-run ``slo`` record the replay is cross-checked against it and
+any disagreement on request/miss counts is reported (exit 1) — the
+pinned-summary-vs-raw-records consistency gate.
+
+Target/availability/windows default to the log's own ``slo`` record
+when present, else to the deadline records' budget; flags override.
+A log with no deadline data reports that plainly and exits 0 (pre-v12
+logs are data-free, never a crash). Exit codes: 0 ok, 1 replay/pinned
+mismatch, 2 unreadable log or unusable flags.
+
+Pure stdlib + ``telemetry.schema`` + ``serving.metrics`` (both jax-free)
+— dispatched by the training CLI before anything jax-heavy loads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from ..serving.metrics import SLOTracker
+from ..telemetry.schema import iter_records
+
+
+def _deadline_records(records: List[dict]) -> List[dict]:
+    return [
+        r for r in records
+        if r.get("kind") == "serving" and r.get("event") == "deadline"
+    ]
+
+
+def _pinned_slo(records: List[dict]) -> Optional[dict]:
+    """The log's LAST end-of-run ``slo`` record, if any."""
+    return next(
+        (r for r in reversed(records) if r.get("kind") == "slo"), None
+    )
+
+
+def _resolve_target_ms(args, pinned: Optional[dict],
+                       deadlines: List[dict]) -> Optional[float]:
+    """Flag > pinned slo record > the deadline records' own budget
+    (the last one wins — within a run it is a constant)."""
+    if args.target_ms is not None:
+        return float(args.target_ms)
+    if pinned is not None and isinstance(
+        pinned.get("target_ms"), (int, float)
+    ):
+        return float(pinned["target_ms"])
+    for r in reversed(deadlines):
+        v = r.get("deadline_ms")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    return None
+
+
+def _replay(records: List[dict], target_ms: float, availability: float,
+            windows: List[float]) -> Dict[str, Any]:
+    tracker = SLOTracker(
+        target_ms=target_ms, availability=availability,
+        burn_windows_s=tuple(windows),
+    )
+    for r in records:
+        tracker.write(r)
+    return tracker.summary()
+
+
+def _render(log: str, summary: Dict[str, Any],
+            mismatch: Optional[str]) -> List[str]:
+    lines = [f"{log}: SLO report"]
+    lines.append(
+        f"  objective: p(on-time) >= {summary['availability']:g} at "
+        f"{summary['target_ms']:g}ms (error budget "
+        f"{summary['error_budget']:g})"
+    )
+    miss_rate = summary.get("miss_rate")
+    lines.append(
+        f"  requests: {summary['requests']}, missed {summary['missed']}"
+        + (
+            f" (miss rate {miss_rate:.4f})" if miss_rate is not None
+            else ""
+        )
+    )
+    burn = summary.get("burn_rates") or {}
+    parts = []
+    for window, rate in burn.items():
+        parts.append(
+            f"{window}s={rate:.2f}" if rate is not None
+            else f"{window}s=-"
+        )
+    if parts:
+        line = "  burn rate: " + ", ".join(parts)
+        if summary.get("worst_burn_rate") is not None:
+            line += (
+                f"  (worst: {summary['worst_burn_rate']:.2f} over "
+                f"{summary['worst_burn_window_s']:g}s"
+            )
+            line += ", OVER BUDGET)" if summary[
+                "worst_burn_rate"
+            ] > 1.0 else ")"
+        lines.append(line)
+    for label, row in sorted((summary.get("per_replica") or {}).items()):
+        lines.append(
+            f"    replica {label}: {row['requests']} request(s), "
+            f"{row['missed']} missed"
+        )
+    if mismatch:
+        lines.append(f"  MISMATCH: {mismatch}")
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="slo",
+        description="Offline SLO report: replay a serving telemetry "
+                    "log's deadline records (error budget, multi-window "
+                    "burn rates, per-replica misses)",
+    )
+    parser.add_argument("log", help="telemetry JSONL path")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    parser.add_argument("--target-ms", type=float, default=None,
+                        help="SLO latency target override (default: the "
+                             "log's slo record, else its deadline "
+                             "records' budget)")
+    parser.add_argument("--availability", type=float, default=None,
+                        help="availability objective override, in (0,1) "
+                             "(default: the log's slo record, else 0.99)")
+    parser.add_argument("--window", action="append", type=float,
+                        default=None, metavar="S",
+                        help="burn-rate window in seconds (repeatable; "
+                             "default: the log's slo record's windows, "
+                             "else 60/300/3600)")
+    args = parser.parse_args(argv)
+
+    try:
+        records = list(iter_records(args.log))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    deadlines = _deadline_records(records)
+    pinned = _pinned_slo(records)
+    if not deadlines and pinned is None:
+        # a pre-v12 log, or a run without deadline accounting: there is
+        # nothing to report, which is an answer, not an error
+        msg = (
+            f"{args.log}: no deadline records and no slo record — "
+            "deadline accounting was not armed (run serve-bench with "
+            "--deadline-ms or serving_slo_target_ms > 0)"
+        )
+        if args.json:
+            print(json.dumps({"log": args.log, "slo": None,
+                              "note": msg}))
+        else:
+            print(msg)
+        return 0
+
+    target_ms = _resolve_target_ms(args, pinned, deadlines)
+    if target_ms is None:
+        print("error: no --target-ms given and the log's records carry "
+              "no deadline budget to infer one from", file=sys.stderr)
+        return 2
+    availability = (
+        args.availability if args.availability is not None
+        else (
+            float(pinned["availability"])
+            if pinned is not None
+            and isinstance(pinned.get("availability"), (int, float))
+            and not isinstance(pinned.get("availability"), bool)
+            else 0.99
+        )
+    )
+    windows = args.window
+    if windows is None:
+        pinned_burn = (pinned or {}).get("burn_rates")
+        if isinstance(pinned_burn, dict) and pinned_burn:
+            try:
+                windows = sorted(float(w) for w in pinned_burn)
+            except (TypeError, ValueError):
+                windows = None
+    if windows is None:
+        windows = [60.0, 300.0, 3600.0]
+    try:
+        summary = _replay(records, target_ms, availability, windows)
+    except ValueError as e:  # bad flag combos (tracker validation)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    # cross-check the replay against the pinned end-of-run summary:
+    # both derive from the same deadline records, so a count mismatch
+    # means a truncated log or a writer bug — surface it loudly
+    mismatch = None
+    if pinned is not None:
+        for key in ("requests", "missed"):
+            if (
+                isinstance(pinned.get(key), int)
+                and pinned[key] != summary[key]
+            ):
+                mismatch = (
+                    f"log's slo record says {key}={pinned[key]}, "
+                    f"replaying its deadline records gives "
+                    f"{summary[key]}"
+                )
+                break
+
+    if args.json:
+        print(json.dumps({
+            "log": args.log,
+            "slo": summary,
+            "pinned": {
+                k: pinned.get(k) for k in ("requests", "missed")
+            } if pinned is not None else None,
+            "mismatch": mismatch,
+        }, sort_keys=True))
+    else:
+        print("\n".join(_render(args.log, summary, mismatch)))
+    return 1 if mismatch else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

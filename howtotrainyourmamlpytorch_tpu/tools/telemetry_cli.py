@@ -21,9 +21,11 @@ here before importing anything jax-heavy)
 * ``summary``   — run overview: record counts by kind, wall-clock span,
   epoch range, final/best validation accuracy, dispatch-timing
   percentiles, loader stream-stall stats, HBM usage,
-  anomaly/incident/stall/retry/preemption/retrace counts, and the
+  anomaly/incident/stall/retry/preemption/retrace counts, the
   elastic drain/resume line (schema v6: drain protocol progress plus the
-  last old->new process-count resume with its episode cursor);
+  last old->new process-count resume with its episode cursor), and the
+  serving SLO line (schema v12: deadline-miss rate, worst burn-rate
+  window, per-replica misses — absent, never a crash, on older logs);
 * ``epochs``    — the per-epoch scalar table (loss/accuracy/step-time
   columns), the epoch CSV's queryable twin;
 * ``anomalies`` — every ``anomaly`` / ``incident`` / ``watchdog_stall`` /
@@ -300,6 +302,10 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
         ),
         "tenants_per_sec": (rollup or {}).get("tenants_per_sec"),
         "retraces": (rollup or {}).get("retraces"),
+        # v12 rollup honesty: dispatches whose samples aged out of the
+        # windowed percentile deques (the merged histograms kept them);
+        # None on pre-v12 logs — the line simply omits it
+        "window_dropped": (rollup or {}).get("window_dropped"),
         # the v9 fast-path fields (None on v8-era logs — the line simply
         # omits them)
         "ingest": (rollup or {}).get("ingest"),
@@ -310,6 +316,77 @@ def _serving_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
         "per_bucket": per_bucket,
     }
     return out
+
+
+def _slo_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Condense the SLO surface (schema v12): deadline-miss totals and a
+    per-replica breakdown recomputed from the per-request ``deadline``
+    records, plus the end-of-run ``slo`` record's target and worst
+    burn-rate window. A log with deadline records but no ``slo`` record
+    (killed mid-run) still reports its counts; a log with neither —
+    every pre-v12 log — returns None and the line simply doesn't
+    render. Malformed fields are skipped, never a crash."""
+    dl = [
+        r for r in records
+        if r.get("kind") == "serving" and r.get("event") == "deadline"
+    ]
+    pinned = next(
+        (r for r in reversed(records) if r.get("kind") == "slo"), None
+    )
+    if not dl and pinned is None:
+        return None
+    requests = len(dl)
+    missed = sum(1 for r in dl if r.get("missed") is True)
+    per_replica: Dict[str, Dict[str, int]] = {}
+    for r in dl:
+        rid = r.get("replica_id")
+        label = (
+            str(rid)
+            if isinstance(rid, int) and not isinstance(rid, bool)
+            else "-"
+        )
+        row = per_replica.setdefault(label, {"requests": 0, "missed": 0})
+        row["requests"] += 1
+        if r.get("missed") is True:
+            row["missed"] += 1
+    if not dl and pinned is not None:
+        # summary-only log (deadline records rotated away): fall back to
+        # the pinned totals, guarded — a malformed record yields zeros
+        if isinstance(pinned.get("requests"), int) and not isinstance(
+            pinned.get("requests"), bool
+        ):
+            requests = pinned["requests"]
+        if isinstance(pinned.get("missed"), int) and not isinstance(
+            pinned.get("missed"), bool
+        ):
+            missed = pinned["missed"]
+        if isinstance(pinned.get("per_replica"), dict):
+            per_replica = {
+                str(k): {
+                    "requests": int(v.get("requests", 0)),
+                    "missed": int(v.get("missed", 0)),
+                }
+                for k, v in pinned["per_replica"].items()
+                if isinstance(v, dict)
+            }
+
+    def _num(key: str) -> Optional[float]:
+        v = (pinned or {}).get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+        return None
+
+    return {
+        "requests": requests,
+        "missed": missed,
+        "miss_rate": (
+            round(missed / requests, 6) if requests else None
+        ),
+        "per_replica": per_replica,
+        "target_ms": _num("target_ms"),
+        "worst_burn_rate": _num("worst_burn_rate"),
+        "worst_burn_window_s": _num("worst_burn_window_s"),
+    }
 
 
 def _dispatch_stats(records: List[dict]) -> Optional[Dict[str, float]]:
@@ -446,6 +523,10 @@ def cmd_summary(args) -> int:
         # adapt-on-request serving (schema v8): dispatch/tenant counts,
         # adapt-latency percentiles, throughput, strict-retrace count
         "serving": _serving_summary(records),
+        # deadline/SLO accounting (schema v12): miss totals recomputed
+        # from the per-request deadline records + the end-of-run slo
+        # record's burn-rate verdict
+        "slo": _slo_summary(records),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -571,6 +652,10 @@ def cmd_summary(args) -> int:
             parts.append(f"{len(sv['per_replica'])} replica(s)")
         if sv.get("rollovers"):
             parts.append(f"{sv['rollovers']} rollover(s)")
+        if sv.get("window_dropped") is not None:
+            parts.append(
+                f"{sv['window_dropped']} aged out of percentile window"
+            )
         if sv.get("retraces"):
             parts.append(f"{sv['retraces']} RETRACE(S)")
         lines.append("  serving: " + ", ".join(parts))
@@ -602,6 +687,29 @@ def cmd_summary(args) -> int:
             if row.get("cache_hit_rate") is not None:
                 sub.append(f"cache hit {row['cache_hit_rate']:.0%}")
             lines.append(f"    serving[{key}]: " + ", ".join(sub))
+    slo = payload["slo"]
+    if slo:
+        parts = [
+            f"{slo['requests']} deadline(s), {slo['missed']} missed"
+        ]
+        if slo.get("miss_rate") is not None:
+            parts.append(f"miss rate {slo['miss_rate']:.2%}")
+        if slo.get("target_ms") is not None:
+            parts.append(f"target {slo['target_ms']:g}ms")
+        if (
+            slo.get("worst_burn_rate") is not None
+            and slo.get("worst_burn_window_s") is not None
+        ):
+            parts.append(
+                f"worst burn {slo['worst_burn_rate']:.2f} over "
+                f"{slo['worst_burn_window_s']:g}s"
+            )
+        lines.append("  slo: " + ", ".join(parts))
+        for label, row in sorted((slo.get("per_replica") or {}).items()):
+            lines.append(
+                f"    slo[replica {label}]: {row['requests']} "
+                f"deadline(s), {row['missed']} missed"
+            )
     audit = payload["audit"]
     if audit:
         line = (

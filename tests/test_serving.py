@@ -2219,3 +2219,450 @@ def test_refresh_partial_failure_resumes_without_double_swap(
         assert sorted(r["replica_id"] for r in rollover_recs) == [0, 1]
     finally:
         ps.close()
+
+
+# -- schema v12: SLO observability — histograms, deadlines, burn rates -------
+
+
+def test_log_histogram_quantiles_merge_and_exposition():
+    """The mergeable latency histogram's three contracts: quantiles
+    agree with raw samples within one bucket's relative error
+    (growth - 1), pool merge is EXACT bucket-by-bucket addition, and
+    the rendered Prometheus exposition passes the parser's histogram
+    validation (cumulative buckets, +Inf == _count)."""
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+        LOG_HISTOGRAM_GROWTH,
+        LogHistogram,
+        parse_prometheus_text,
+    )
+
+    rng = np.random.RandomState(101)
+    samples = np.exp(rng.randn(4000) * 1.5 + 1.0)  # lognormal ms
+    h = LogHistogram()
+    for s in samples:
+        h.observe(float(s))
+    rel = LOG_HISTOGRAM_GROWTH - 1.0
+    for q in (0.5, 0.95, 0.99):
+        exact = float(np.percentile(samples, q * 100))
+        est = h.quantile(q)
+        assert abs(est - exact) <= rel * exact + 1e-9, (
+            f"q={q}: histogram {est} vs raw {exact} beyond one bucket"
+        )
+    # exact merge: two disjoint halves re-merge to the full histogram
+    a, b = LogHistogram(), LogHistogram()
+    for s in samples[:2000]:
+        a.observe(float(s))
+    for s in samples[2000:]:
+        b.observe(float(s))
+    m = LogHistogram()
+    m.merge(a)
+    m.merge(b)
+    assert m.counts == h.counts
+    assert m.count == h.count == 4000
+    assert m.min == h.min and m.max == h.max
+    assert m.quantile(0.95) == h.quantile(0.95)
+    # serialization round-trips through the telemetry-record form
+    back = LogHistogram.from_dict(h.to_dict())
+    assert back.counts == h.counts and back.count == h.count
+    # mismatched ladders must refuse to merge (silent corruption)
+    other = LogHistogram(low=1e-2)
+    with pytest.raises(ValueError, match="ladder"):
+        h.merge(other)
+    # the exposition validates as a real Prometheus histogram
+    text = "\n".join(h.render("t_ms", "test latency")) + "\n"
+    series = parse_prometheus_text(text)
+    assert series["t_ms_count"][""] == 4000
+    assert series["t_ms_bucket"]['le="+Inf"'] == 4000
+
+
+def test_slo_tracker_burn_rate_math():
+    """Burn rate = window miss rate / error budget, windows anchored to
+    the NEWEST record timestamp — so a replayed log reads the same
+    numbers the live endpoint showed."""
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import SLOTracker
+
+    tr = SLOTracker(target_ms=50.0, availability=0.99,
+                    burn_windows_s=(60.0, 3600.0))
+    t0 = 1_800_000_000.0
+    for i in range(100):
+        tr.write({
+            "kind": "serving", "event": "deadline", "ts": t0 + i,
+            "deadline_ms": 50.0, "slack_ms": 1.0,
+            "missed": i == 99,  # the one miss lands in the newest second
+        })
+    s = tr.summary()
+    assert s["requests"] == 100 and s["missed"] == 1
+    # 60s window holds the last 60 events (1 miss): 1/60 / 0.01
+    assert s["burn_rates"]["60"] == pytest.approx((1 / 60) / 0.01,
+                                                  rel=1e-6)
+    assert s["burn_rates"]["3600"] == pytest.approx(0.01 / 0.01, rel=1e-6)
+    assert s["worst_burn_window_s"] == 60.0
+    assert s["error_budget"] == pytest.approx(0.01)
+    # non-deadline records are ignored (the tracker tees off the full
+    # serving stream)
+    tr.write({"kind": "serving", "event": "dispatch", "tenants": 3})
+    assert tr.summary()["requests"] == 100
+    with pytest.raises(ValueError, match="availability"):
+        SLOTracker(target_ms=50.0, availability=1.5)
+    with pytest.raises(ValueError, match="windows"):
+        SLOTracker(target_ms=50.0, burn_windows_s=())
+
+
+def test_micro_batcher_deadline_accounting(cfg, engine):
+    """Every deadline-carrying request resolves to exactly one
+    schema-valid `deadline` record with slack/miss and the stage
+    attribution; requests without a deadline emit none; a non-positive
+    budget is refused at submit."""
+    sink = _ListSink()
+    old_sink = engine.sink
+    engine.sink = sink
+    batcher = MicroBatcher(engine, max_wait_ms=0.0)
+    rng = np.random.RandomState(67)
+    try:
+        req_met = _request(cfg, rng, tenant_id="t-met")
+        req_met.deadline_ms = 60_000.0
+        req_miss = _request(cfg, rng, tenant_id="t-miss")
+        req_miss.deadline_ms = 1e-3
+        met = batcher.submit(req_met)
+        missed = batcher.submit(req_miss)
+        plain = batcher.submit(_request(cfg, rng, tenant_id="t-plain"))
+        for p in (met, missed, plain):
+            assert p.get(timeout=300) is not None
+        bad = _request(cfg, rng)
+        bad.deadline_ms = 0.0
+        with pytest.raises(ValueError, match="deadline_ms"):
+            batcher.submit(bad)
+    finally:
+        batcher.close()
+        engine.sink = old_sink
+    dl = [r for r in sink.records if r.get("event") == "deadline"]
+    assert len(dl) == 2  # the plain request emitted NO deadline record
+    by_tenant = {r["tenant_id"]: r for r in dl}
+    assert set(by_tenant) == {"t-met", "t-miss"}
+    for r in dl:
+        tel.validate_record(r)
+        assert r["schema"] == tel.SCHEMA_VERSION
+        # stage attribution: queue + route ride along with the budget
+        assert r["e2e_ms"] >= r["queue_ms"] >= 0
+        assert r["route_ms"] == 0.0  # no router on the direct path
+        assert r["deadline_ms"] > 0
+        assert r["missed"] == (r["slack_ms"] < 0)
+    assert by_tenant["t-met"]["missed"] is False
+    assert by_tenant["t-miss"]["missed"] is True
+
+
+def _mk_deadline_request(cfg, rng, deadline_ms):
+    req = _request(cfg, rng)
+    req.deadline_ms = deadline_ms
+    return req
+
+
+def test_slo_three_way_agreement_scrape_log_cli(cfg, engine, tmp_path,
+                                                capsys):
+    """The acceptance contract: /metrics, the JSONL `slo`/`deadline`
+    records, and `cli slo` all derive from ONE record stream and agree
+    on the deadline-miss counts."""
+    import urllib.request
+
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+        FanoutSink,
+        MetricsServer,
+        ServingMetrics,
+        SLOTracker,
+        parse_prometheus_text,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import (
+        JsonlSink,
+        make_record,
+    )
+    from howtotrainyourmamlpytorch_tpu.tools import slo_cli
+
+    log = tmp_path / "slo.jsonl"
+    jsonl = JsonlSink(str(log))
+    slo = SLOTracker(target_ms=50.0)
+    metrics = ServingMetrics(slo=slo)
+    sink = FanoutSink(jsonl, metrics)
+    old_sink = engine.sink
+    engine.sink = sink
+    server = MetricsServer(metrics, port=0)
+    batcher = MicroBatcher(engine, max_wait_ms=0.0)
+    rng = np.random.RandomState(71)
+    try:
+        pendings = [
+            batcher.submit(_mk_deadline_request(cfg, rng, 60_000.0))
+            for _ in range(3)
+        ] + [
+            batcher.submit(_mk_deadline_request(cfg, rng, 1e-3))
+            for _ in range(2)
+        ]
+        for p in pendings:
+            assert p.get(timeout=300) is not None
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        server.close()
+        batcher.close()
+        engine.sink = old_sink
+    sink.write(make_record("slo", **slo.summary()))
+    sink.close()
+    # the live scrape (parse validates histogram exposition too)
+    series = parse_prometheus_text(text)
+    assert series["serving_deadline_met_total"][""] == 3
+    assert series["serving_deadline_missed_total"][""] == 2
+    assert series["serving_slo_error_budget"][""] == pytest.approx(0.01)
+    assert any(
+        k.startswith("serving_slo_burn_rate")
+        for k in series
+    )
+    # the JSONL stream: 5 deadline records (2 missed) + the slo record,
+    # all schema-valid
+    tel.validate_file(str(log))
+    recs = list(tel.iter_records(str(log)))
+    dl = [r for r in recs if r.get("event") == "deadline"]
+    assert len(dl) == 5
+    assert sum(1 for r in dl if r["missed"]) == 2
+    pinned = [r for r in recs if r["kind"] == "slo"]
+    assert len(pinned) == 1
+    assert pinned[0]["requests"] == 5 and pinned[0]["missed"] == 2
+    # the offline replay agrees and exits 0 (the CI gate)
+    assert slo_cli.main([str(log), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["mismatch"] is None
+    assert payload["slo"]["requests"] == 5
+    assert payload["slo"]["missed"] == 2
+    assert payload["slo"]["target_ms"] == 50.0
+    # text mode renders the report, still exit 0
+    assert slo_cli.main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "SLO report" in out and "missed 2" in out
+
+
+def test_slo_cli_no_deadline_data_exits_zero(tmp_path, capsys):
+    """A pre-v12 log (no deadline/slo records) is an answer, not a
+    crash: `cli slo` reports the absence and exits 0."""
+    from howtotrainyourmamlpytorch_tpu.tools import slo_cli
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v11_schema.jsonl"
+    )
+    assert slo_cli.main([fixture]) == 0
+    assert "no deadline records" in capsys.readouterr().out
+    assert slo_cli.main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_inspect_summary_renders_slo_line(tmp_path, capsys):
+    """`cli inspect summary` renders the v12 slo line (miss rate, worst
+    burn window, per-replica breakdown) — and pre-v12 logs render
+    without one, never a crash."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import make_record
+    from howtotrainyourmamlpytorch_tpu.tools import telemetry_cli
+
+    log = tmp_path / "slo_log.jsonl"
+    with open(log, "w") as f:
+        for i in range(4):
+            f.write(json.dumps(make_record(
+                "serving", event="deadline", deadline_ms=50.0,
+                slack_ms=(-5.0 if i == 3 else 12.0), missed=(i == 3),
+                e2e_ms=40.0, queue_ms=1.0, route_ms=0.1,
+                replica_id=i % 2,
+            )) + "\n")
+        f.write(json.dumps(make_record(
+            "slo", target_ms=50.0, availability=0.99, requests=4,
+            missed=1, worst_burn_rate=25.0, worst_burn_window_s=60.0,
+        )) + "\n")
+    assert telemetry_cli.main(["summary", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "slo: 4 deadline(s), 1 missed" in out
+    assert "worst burn 25.00 over 60s" in out
+    assert "slo[replica 0]" in out and "slo[replica 1]" in out
+    assert telemetry_cli.main(["summary", str(log), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["slo"]["miss_rate"] == 0.25
+    assert payload["slo"]["per_replica"]["1"]["missed"] == 1
+    # pre-v12 log: no slo line, exit 0
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v11_schema.jsonl"
+    )
+    assert telemetry_cli.main(["summary", fixture]) == 0
+    assert "slo:" not in capsys.readouterr().out
+
+
+def test_pool_watchdogs_replica_tagged_and_rewired(pool_cfg, state):
+    """Satellite: per-replica watchdogs. attach_watchdogs puts one
+    replica-tagged watchdog on every engine; a stall record carries the
+    replica_id; _rewire_watchdog (the restart_replica hook) retires the
+    old dog and arms a fresh one on the replacement engine."""
+    import time as _time
+
+    sink = _ListSink()
+    ps = ReplicaSet(
+        pool_cfg, state, n_replicas=2, devices=jax.devices()[:2],
+        shots_buckets=(1,), sink=sink, strict_retrace=True,
+    )
+    # no warmup needed: the watchdog wraps the engine object, not its
+    # compiled programs
+    try:
+        dogs = ps.attach_watchdogs(0.15, sink=sink)
+        assert len(dogs) == 2
+        for r in ps.replicas:
+            assert r.engine.watchdog is ps._watchdogs[r.replica_id]
+        # wedge replica 1 (beat once, never again)
+        ps.replicas[1].engine.watchdog.beat("serve_step[i=f32,b=1,s=1]")
+        deadline = _time.perf_counter() + 5.0
+        while (
+            not any(r.get("kind") == "watchdog_stall"
+                    and r.get("replica_id") == 1
+                    for r in sink.records)
+            and _time.perf_counter() < deadline
+        ):
+            _time.sleep(0.05)
+        stalls = [
+            r for r in sink.records if r.get("kind") == "watchdog_stall"
+            and r.get("replica_id") == 1
+        ]
+        assert stalls, "no replica-tagged stall record within 5s"
+        tel.validate_record(stalls[0])
+        # rewire: the restart path must not leave the dead engine's dog
+        # running nor the fresh engine unwatched
+        old_dog = ps._watchdogs[0]
+        ps._rewire_watchdog(ps.replicas[0])
+        assert ps._watchdogs[0] is not old_dog
+        assert ps.replicas[0].engine.watchdog is ps._watchdogs[0]
+    finally:
+        ps.close()
+    # close() stopped and cleared every watchdog
+    assert not ps._watchdogs
+    for r in ps.replicas:
+        assert getattr(r.engine, "watchdog", None) is None
+
+
+@pytest.mark.slow
+def test_histograms_and_watchdog_survive_rollover(pool_cfg, state):
+    """The rollover continuity contract: after a mid-run swap_engine,
+    the pool histogram equals the EXACT bucket-by-bucket merge of
+    everything served (pre- and post-swap — adopt_serving_history
+    merged the old engine's buckets), window_dropped is honest, and
+    the per-replica watchdog rides into the standby."""
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import LogHistogram
+
+    sink = _ListSink()
+    ps = ReplicaSet(
+        pool_cfg, state, n_replicas=1, devices=jax.devices()[:1],
+        shots_buckets=(1,), sink=sink, strict_retrace=True,
+    )
+    ps.warmup()
+    try:
+        ps.attach_watchdogs(600.0, sink=sink)
+        dog = ps.replicas[0].engine.watchdog
+        assert dog is not None
+        rng = np.random.RandomState(73)
+        replica = ps.replicas[0]
+        for _ in range(3):
+            assert replica.submit(
+                _request(pool_cfg, rng)
+            ).get(timeout=300) is not None
+        standby = ps.build_standby_engine(0, state)
+        standby.warmup()
+        swap = replica.swap_engine(standby)
+        assert swap["xla_compiles_at_swap"] == 0
+        # the watchdog survived the swap onto the standby engine
+        assert replica.engine.watchdog is dog
+        for _ in range(2):
+            assert replica.submit(
+                _request(pool_cfg, rng)
+            ).get(timeout=300) is not None
+        ru = ps.rollup()
+        # exact merge: rebuild the histogram from the record stream the
+        # run emitted (pre-swap dispatches included) and compare
+        # bucket-by-bucket
+        expect = LogHistogram()
+        adapt = [
+            r["adapt_ms"] for r in sink.records
+            if r.get("kind") == "serving" and r.get("event") == "dispatch"
+        ]
+        for v in adapt:
+            expect.observe(float(v))
+        assert len(adapt) == 5
+        assert ru["adapt_ms_hist"]["counts"] == expect.to_dict()["counts"]
+        assert ru["adapt_ms_hist"]["count"] == 5
+        assert ru["window_dropped"] == 0  # nothing aged out: honest zero
+        back = LogHistogram.from_dict(ru["adapt_ms_hist"])
+        assert back.quantile(0.5) == expect.quantile(0.5)
+        # the rollup record (with the histogram payload) is schema-valid
+        rollup_recs = [
+            r for r in sink.records
+            if r.get("kind") == "serving" and r.get("event") == "rollup"
+        ]
+        assert rollup_recs
+        for r in rollup_recs:
+            tel.validate_record(r)
+    finally:
+        ps.close()
+
+
+def test_serve_bench_openloop_arg_validation():
+    """Open-loop flags are validated before any jax import: an arrival
+    schedule needs --rate, --rate needs an open-loop arrival, and the
+    Zipf popularity law must be normalizable."""
+    from howtotrainyourmamlpytorch_tpu.serving import bench as serve_bench
+
+    for argv in (
+        ["--fast", "--arrival", "poisson"],            # no --rate
+        ["--fast", "--rate", "50"],                    # closed + rate
+        ["--fast", "--arrival", "poisson", "--rate", "0"],
+        ["--fast", "--arrival", "poisson", "--rate", "50",
+         "--deadline-ms", "0"],
+        ["--fast", "--arrival", "zipf", "--rate", "50",
+         "--zipf-exponent", "1.0"],
+        ["--fast", "--arrival", "bursty", "--rate", "50",
+         "--burst-period-s", "0"],
+        ["--fast", "--arrival", "poisson", "--rate", "50",
+         "--rollover"],
+    ):
+        with pytest.raises(SystemExit) as ei:
+            serve_bench.main(argv)
+        assert ei.value.code == 2, argv
+
+
+def test_arrival_schedules_deterministic_and_shaped():
+    """The fixed-seed arrival generators: same seed, same schedule;
+    Poisson offsets are sorted with the right mean; bursty offsets land
+    only in the ON half of each period; Zipf traffic skews toward the
+    head tenants by reusing their exact request objects."""
+    import argparse as _ap
+
+    from howtotrainyourmamlpytorch_tpu.serving.bench import (
+        _arrival_schedule,
+        _zipf_requests,
+    )
+
+    def ns(**kw):
+        return _ap.Namespace(**kw)
+
+    args = ns(arrival="poisson", rate=100.0, seed=3, burst_period_s=1.0)
+    a = _arrival_schedule(args, 500)
+    b = _arrival_schedule(args, 500)
+    assert a == b  # pure function of the seed
+    assert a == sorted(a)
+    # mean inter-arrival ~ 1/rate (law of large numbers, loose tol)
+    assert a[-1] / 500 == pytest.approx(1 / 100.0, rel=0.25)
+    burst = _arrival_schedule(
+        ns(arrival="bursty", rate=100.0, seed=3, burst_period_s=0.5), 400
+    )
+    assert burst == sorted(burst)
+    for t in burst:
+        assert (t % 0.5) < 0.25 + 1e-9, (
+            f"bursty arrival at {t} landed in the OFF half-period"
+        )
+    # zipf: the head tenant serves far more than the tail, via the SAME
+    # request object (content-fingerprint cache hits)
+    cfg = make_serving_cfg()
+    reqs = _zipf_requests(
+        cfg, [1], 200, ns(seed=3, zipf_exponent=1.5), "f32", 0
+    )
+    assert len(reqs) == 200
+    by_id = {}
+    for r in reqs:
+        by_id[id(r)] = by_id.get(id(r), 0) + 1
+    counts = sorted(by_id.values(), reverse=True)
+    assert counts[0] >= 10 * counts[-1]  # hot head, cold tail

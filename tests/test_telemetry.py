@@ -926,3 +926,75 @@ def test_v11_replica_id_rides_serving_records():
         "serving", event="dispatch", tenants=2, bucket=2, shots=1,
         queue_ms=0.5, adapt_ms=4.0, program="adapt", ingest="f32",
     ))
+
+
+# -- schema v12: serving SLO observability (slo kind + deadline shape) -------
+
+
+def test_validate_file_accepts_v11_era_fixture():
+    """The pinned v11-era log (replica_id-tagged serving records and the
+    rollover shape of the PREVIOUS schema) validates unchanged under
+    v12 — pure addition, nothing tightened."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v11_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 7
+
+
+def test_v12_slo_record_round_trips():
+    """The slo kind (SLOTracker.summary through make_record): full field
+    set validates, JSON round-trips, and the required-field floor
+    (target_ms / requests / missed) is enforced."""
+    rec = tel.make_record(
+        "slo", target_ms=50.0, availability=0.99, error_budget=0.01,
+        requests=120, missed=3, miss_rate=0.025,
+        burn_rates={"60": 2.5, "300": 1.1, "3600": None},
+        worst_burn_window_s=60.0, worst_burn_rate=2.5,
+        per_replica={"replica=\"0\"": {"requests": 60, "missed": 1}},
+    )
+    assert rec["schema"] == tel.SCHEMA_VERSION and rec["kind"] == "slo"
+    tel.validate_record(rec)
+    assert json.loads(json.dumps(rec, allow_nan=False)) == rec
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "slo",
+            "target_ms": 50.0,
+        })
+
+
+def test_v12_deadline_record_validates():
+    """The serving event='deadline' shape: one resolved deadline-carrying
+    request with its slack/miss verdict and the stage attribution."""
+    rec = tel.make_record(
+        "serving", event="deadline", tenant_id="t-042", shots=1,
+        deadline_ms=50.0, slack_ms=-12.4, missed=True, e2e_ms=62.4,
+        queue_ms=55.0, route_ms=0.1, batch_ms=0.8, dispatch_ms=1.9,
+        sync_ms=4.7, replica_id=1,
+    )
+    assert rec["schema"] == tel.SCHEMA_VERSION
+    tel.validate_record(rec)
+    json.dumps(rec, allow_nan=False)
+
+
+def test_v12_histogram_bearing_rollup_round_trips():
+    """The rollup's v12 honesty/distribution fields (window_dropped +
+    the sparse LogHistogram dicts) ride make_record untouched and the
+    histogram reconstructs losslessly from the JSON round-trip."""
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import LogHistogram
+
+    hist = LogHistogram()
+    for v in (0.5, 2.0, 2.1, 40.0, 41.0, 39.0, 1000.0):
+        hist.observe(v)
+    rec = tel.make_record(
+        "serving", event="rollup", dispatches=7, tenants=7, retraces=0,
+        adapt_ms_p50=40.0, adapt_ms_p95=1000.0, tenants_per_sec=12.0,
+        window_dropped=0, adapt_ms_hist=hist.to_dict(),
+        queue_ms_hist=LogHistogram().to_dict(),
+    )
+    tel.validate_record(rec)
+    wire = json.loads(json.dumps(rec, allow_nan=False))
+    back = LogHistogram.from_dict(wire["adapt_ms_hist"])
+    assert back.counts == hist.counts
+    assert back.count == hist.count and back.min == hist.min
+    assert back.quantile(0.5) == hist.quantile(0.5)
+    assert wire["window_dropped"] == 0
