@@ -33,9 +33,13 @@ serving metrics module, also dispatched jax-free) is the offline SLO
 report: it replays a serving log's schema-v12 ``deadline`` records
 through the same tracker the live ``/metrics`` endpoint runs (miss
 rate, error budget, multi-window burn rates, per-replica misses) and
-cross-checks the log's end-of-run ``slo`` record against the replay:
+cross-checks the log's end-of-run ``slo`` record against the replay;
+``--fleet`` merges a serve-bench ``--fleet`` run's per-host logs
+(auto-discovered ``root.hostNN.ext`` siblings) into one ts-sorted
+stream replayed through a single tracker, reported per HOST:
 
     python -m howtotrainyourmamlpytorch_tpu.cli slo LOG [--json]
+    python -m howtotrainyourmamlpytorch_tpu.cli slo --fleet GATEWAY_LOG
 
 The ``lint`` subcommand (analysis/lint.py — pure stdlib, also dispatched
 jax-free) runs the repo-specific JAX-pitfall linter; the ``audit``
@@ -67,7 +71,16 @@ poisson|bursty|zipf --rate R`` switches it OPEN-LOOP (a fixed-seed
 arrival schedule submitted against the wall clock — the queueing-
 collapse regime the closed loop cannot produce) and ``--deadline-ms``
 arms per-request deadline accounting: deadline records in the log, an
-``slo`` block in the line, burn-rate gauges on ``--metrics-port``. The ``serve-export``
+``slo`` block in the line, burn-rate gauges on ``--metrics-port``.
+``--fleet H`` scales past one process: H fleet-host subprocesses (one
+``ReplicaSet`` + affinity router each, serving/fleet.py) behind one
+HTTP gateway (serving/gateway.py — framed binary wire schema reusing
+the ingest encodings, fleet-wide consistent-hash cache affinity,
+admission control + deadline shedding + priority tiers at the edge,
+health-checked membership with deterministic re-homing), driven
+open-loop through real sockets; ``--kill-host-at K`` SIGKILLs a host
+mid-run to exercise re-homing, and the line gains a ``fleet`` block
+(admitted p50/p95/p99, goodput, shed/re-home/stranded counts). The ``serve-export``
 subcommand (serving/export.py — needs jax) writes those artifacts: the
 warmed (bucket x shots) program ladder serialized to a versioned dir
 keyed by device-kind/dtype/config-fingerprint, which a later engine

@@ -468,6 +468,20 @@ class MAMLConfig:
     serving_slo_burn_windows_s: List[float] = field(
         default_factory=lambda: [60.0, 300.0, 3600.0]
     )
+    # fleet gateway (serving/gateway.py): the per-host admission budget —
+    # a request is shed (typed 'admission' rejection) when its home
+    # host's queue-depth + in-flight estimate reaches this budget,
+    # right-shifted by the request's priority tier (tier 0 keeps the
+    # full budget, tier 1 half, tier 2 a quarter, ...). Must be >= 1.
+    serving_gateway_queue_budget: int = 64
+    # how many admission tiers the gateway accepts (priorities
+    # 0..tiers-1, 0 highest; an out-of-range wire priority is clamped).
+    # Must be >= 1.
+    serving_gateway_priority_tiers: int = 3
+    # gateway health-poll cadence in seconds: how often the membership
+    # thread probes each host's /healthz and trips unreachable hosts out
+    # of the consistent-hash ring. Must be > 0.
+    serving_gateway_health_interval_s: float = 0.5
 
     # --- static analysis (analysis/) --------------------------------------
     # program-contract audits + runtime retrace detection:
@@ -878,6 +892,38 @@ class MAMLConfig:
                 "increasing list of positive seconds (the multi-window "
                 f"burn-rate alerting form), got {windows!r}"
             )
+        # fleet gateway knobs (serving/gateway.py)
+        for knob in (
+            "serving_gateway_queue_budget",
+            "serving_gateway_priority_tiers",
+        ):
+            val = getattr(self, knob)
+            if isinstance(val, float) and val.is_integer():
+                setattr(self, knob, int(val))
+            val = getattr(self, knob)
+            if not (
+                isinstance(val, int)
+                and not isinstance(val, bool)
+                and val >= 1
+            ):
+                raise ValueError(
+                    f"{knob} must be an int >= 1 (the gateway sheds "
+                    "against the budget and clamps priorities into the "
+                    f"tier range), got {val!r}"
+                )
+        if not (
+            isinstance(self.serving_gateway_health_interval_s, (int, float))
+            and not isinstance(self.serving_gateway_health_interval_s, bool)
+            and self.serving_gateway_health_interval_s > 0
+        ):
+            raise ValueError(
+                "serving_gateway_health_interval_s must be > 0 (the "
+                "membership thread's /healthz poll cadence), got "
+                f"{self.serving_gateway_health_interval_s!r}"
+            )
+        self.serving_gateway_health_interval_s = float(
+            self.serving_gateway_health_interval_s
+        )
         if self.analysis_level not in ("off", "warn", "strict"):
             raise ValueError(
                 f"analysis_level must be 'off', 'warn' or 'strict', got "

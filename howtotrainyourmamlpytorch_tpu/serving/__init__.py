@@ -32,6 +32,17 @@ request-driven hot path:
   cache-affinity routing (stable support-set fingerprint -> home
   replica, so LRU hit rates survive scale-out), queue-depth spillover
   and per-replica circuit breaking;
+* :mod:`serving.gateway` — the networked fleet front tier: a framed
+  binary wire schema reusing the ingest encodings (uint8/index
+  compression applies on the wire too), fleet-wide consistent-hash
+  cache affinity over the same support-digest fingerprint, admission
+  control + deadline-aware load shedding + priority tiers at the edge,
+  health-checked host membership with deterministic re-homing, and the
+  exact-merge fleet histogram rollup;
+* :mod:`serving.fleet`   — one fleet HOST process: a ``ReplicaSet`` +
+  affinity router behind the wire-frame HTTP endpoint
+  (``python -m ...serving.fleet`` runs one standalone; serve-bench
+  ``--fleet N`` spawns N behind one gateway);
 * :mod:`serving.refresh` — the checkpoint-rollover refresh daemon:
   watches the experiment dir, pre-warms each new snapshot into a
   standby engine off the hot path and swaps replicas one at a time
@@ -47,6 +58,15 @@ from .engine import (
     attach_serving_watchdog,
     load_servable_snapshot,
 )
+# NOTE: serving.fleet is NOT imported here — it is runnable as
+# ``python -m ...serving.fleet`` (one host process), and a package-level
+# import would shadow runpy's fresh __main__ execution of the module.
+from .gateway import (
+    Gateway,
+    GatewayClient,
+    GatewayServer,
+    home_host,
+)
 from .metrics import FanoutSink, MetricsServer, ServingMetrics
 from .refresh import RefreshDaemon
 from .replica import Replica, ReplicaSet, partition_devices
@@ -55,6 +75,9 @@ from .router import ReplicaRouter, home_replica, request_fingerprint
 __all__ = [
     "AdaptRequest",
     "FanoutSink",
+    "Gateway",
+    "GatewayClient",
+    "GatewayServer",
     "IndexRequest",
     "MetricsServer",
     "MicroBatcher",
@@ -65,6 +88,7 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "attach_serving_watchdog",
+    "home_host",
     "home_replica",
     "load_servable_snapshot",
     "partition_devices",

@@ -61,6 +61,15 @@ class AdaptRequest:
     #: this request — slack or miss, with the stage attribution
     #: (queue/route/assemble/dispatch/sync) — at resolution.
     deadline_ms: Optional[float] = None
+    #: admission tier stamped by the fleet gateway (0 = highest; see
+    #: serving/gateway.py) — None for in-process traffic that never
+    #: crossed the edge. Rides into the deadline record when set.
+    priority: Optional[int] = None
+    #: milliseconds the request spent at the network edge (gateway
+    #: decode + admission + forward) before the home host enqueued it —
+    #: the gateway's share of the deadline record's stage attribution.
+    #: None for in-process traffic.
+    gateway_ms: Optional[float] = None
 
     @property
     def shots(self) -> int:
@@ -89,6 +98,9 @@ class IndexRequest:
     tenant_id: Optional[str] = None
     #: see ``AdaptRequest.deadline_ms``
     deadline_ms: Optional[float] = None
+    #: see ``AdaptRequest.priority`` / ``AdaptRequest.gateway_ms``
+    priority: Optional[int] = None
+    gateway_ms: Optional[float] = None
 
     @property
     def shots(self) -> int:
@@ -513,6 +525,15 @@ class MicroBatcher:
             )
             if failed:
                 fields["failed"] = True
+            # gateway-path attribution (schema v13): present only when
+            # the request crossed the network edge (serving/gateway.py
+            # stamps both) — in-process traffic emits the v12 shape
+            priority = getattr(p.request, "priority", None)
+            if priority is not None:
+                fields["priority"] = int(priority)
+            gateway_ms = getattr(p.request, "gateway_ms", None)
+            if gateway_ms is not None:
+                fields["gateway_ms"] = round(float(gateway_ms), 3)
             if dr is not None:
                 fields.update(
                     batch_ms=round(dr.batch_ms, 3),

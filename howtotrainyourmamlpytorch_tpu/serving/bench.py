@@ -433,6 +433,264 @@ def _drive_pool(args, cfg, pool, router, requests, state, sink,
             "open_loop_late_ms_max": open_late_ms}
 
 
+def _host_log_path(base: str, host_id: str) -> str:
+    """Per-host telemetry path: ``telemetry.jsonl`` ->
+    ``telemetry.host00.jsonl`` (the ``cli slo --fleet`` input set)."""
+    root, ext = os.path.splitext(base)
+    return f"{root}.{host_id}{ext}"
+
+
+class _FleetPending:
+    """One in-flight socket request: a thread per submission (the
+    open-loop generator must never block on the fleet), resolving to a
+    ``GatewayReply`` or the transport error — a request with NEITHER is
+    STRANDED, the zero-stranded acceptance counter."""
+
+    def __init__(self, client, body: bytes):
+        import threading
+
+        self.reply = None
+        self.error: Optional[BaseException] = None
+        self.e2e_ms: Optional[float] = None
+        self._done = threading.Event()
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._run, args=(client, body), daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, client, body: bytes) -> None:
+        try:
+            self.reply = client.serve_frame(body)
+        except BaseException as e:  # noqa: BLE001 - counted as stranded
+            self.error = e
+        finally:
+            self.e2e_ms = (time.perf_counter() - self._t0) * 1e3
+            self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+def _spawn_fleet_hosts(args, n_hosts: int, per_host_replicas: int,
+                       ingest: str):
+    """Start N fleet-host processes and wait for their readiness
+    lines. Returns ``(procs, members)`` — ``{host_id: Popen}`` and the
+    gateway membership ``{host_id: address}``."""
+    import subprocess
+    import threading
+
+    procs, members = {}, {}
+    for i in range(n_hosts):
+        host_id = f"host{i:02d}"
+        cmd = [
+            sys.executable, "-m",
+            "howtotrainyourmamlpytorch_tpu.serving.fleet",
+            "--host-id", host_id, "--port", "0",
+            "--replicas", str(per_host_replicas),
+            "--ingest", ingest,
+            "--seed", str(args.seed),
+        ]
+        if args.config:
+            cmd += ["--config", args.config]
+        elif args.fast:
+            cmd += ["--fast"]
+        if args.emulate_device_ms:
+            cmd += ["--emulate-device-ms", str(args.emulate_device_ms)]
+        if args.cache_size is not None:
+            cmd += ["--cache-size", str(args.cache_size)]
+        if args.telemetry:
+            cmd += ["--telemetry",
+                    _host_log_path(args.telemetry, host_id)]
+        procs[host_id] = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, text=True
+        )
+    for host_id, proc in procs.items():
+        got: dict = {}
+
+        def _read(p=proc, out=got):
+            out["line"] = p.stdout.readline()
+
+        reader = threading.Thread(target=_read, daemon=True)
+        reader.start()
+        reader.join(timeout=300)
+        line = got.get("line")
+        if not line:
+            for p in procs.values():
+                p.kill()
+            raise RuntimeError(
+                f"fleet host {host_id} never printed its readiness "
+                f"line (exit code {proc.poll()})"
+            )
+        ready = json.loads(line)
+        members[host_id] = f"127.0.0.1:{ready['port']}"
+    return procs, members
+
+
+def _drive_fleet(args, cfg, shots_buckets, n_requests, deadline_ms):
+    """The ``--fleet H`` driver: H host processes behind the gateway,
+    the fixed-seed open-loop schedule submitted through real sockets
+    in the wire format, optional mid-run SIGKILL of one host. Prints
+    the JSON line with the `fleet` block and returns the exit code —
+    this process never imports jax."""
+    import signal
+
+    from .gateway import (
+        Gateway,
+        GatewayClient,
+        GatewayServer,
+        encode_request,
+    )
+
+    ingest = args.ingest or cfg.serving_ingest
+    cap = cfg.serving_max_tenants_per_dispatch
+    store_rows = 256  # _synth_store default — hosts build the same one
+    if args.arrival == "zipf":
+        requests = _zipf_requests(
+            cfg, shots_buckets, n_requests, args, ingest=ingest,
+            store_rows=store_rows,
+        )
+    else:
+        groups = _synth_groups(
+            cfg, shots_buckets, n_requests, cap, args.seed,
+            ingest=ingest, store_rows=store_rows,
+            repeat_fraction=args.repeat_tenant_fraction,
+        )
+        requests = [r for g in groups for r in g]
+    offsets = _arrival_schedule(args, len(requests))
+
+    procs, members = _spawn_fleet_hosts(
+        args, args.fleet, args.replicas or 1, ingest
+    )
+    sink = None
+    if args.telemetry:
+        from ..telemetry.sinks import JsonlSink
+
+        sink = JsonlSink(args.telemetry)
+    gateway = Gateway(cfg, members, sink=sink)
+    exit_code = 1
+    try:
+        gateway.wait_ready(timeout_s=300)
+        server = GatewayServer(gateway, port=0)
+        client = GatewayClient(f"127.0.0.1:{server.port}")
+        kill_id = sorted(members)[-1]
+        killed = None
+        tiers = int(cfg.serving_gateway_priority_tiers)
+        t0 = time.perf_counter()
+        pendings: List[_FleetPending] = []
+        late_ms_max = 0.0
+        wire_bytes = 0
+        for i, (req, off) in enumerate(zip(requests, offsets)):
+            if args.kill_host_at is not None and i == args.kill_host_at:
+                os.kill(procs[kill_id].pid, signal.SIGKILL)
+                procs[kill_id].wait()
+                killed = kill_id
+            now = time.perf_counter() - t0
+            if off > now:
+                time.sleep(off - now)
+            else:
+                late_ms_max = max(late_ms_max, (now - off) * 1e3)
+            # per-SUBMISSION fields stamped then encoded immediately:
+            # repeat-tenant traffic reuses request OBJECTS, so the frame
+            # must capture this submission's priority/deadline
+            req.priority = (i % tiers) if args.priority_spread else None
+            if deadline_ms is not None:
+                req.deadline_ms = float(deadline_ms)
+            body = encode_request(req)
+            wire_bytes += len(body)
+            pendings.append(_FleetPending(client, body))
+        stranded = 0
+        for p in pendings:
+            if not p.wait(timeout=600):
+                stranded += 1
+        span_s = time.perf_counter() - t0
+        admitted_ms, met = [], 0
+        shed = {"admission": 0, "deadline": 0}
+        host_down = failed = 0
+        for p in pendings:
+            if p.error is not None or p.reply is None:
+                failed += 1
+            elif p.reply.ok:
+                admitted_ms.append(p.e2e_ms)
+                if deadline_ms is None or p.e2e_ms <= deadline_ms:
+                    met += 1
+            elif p.reply.shed_reason is not None:
+                shed[p.reply.shed_reason] = (
+                    shed.get(p.reply.shed_reason, 0) + 1
+                )
+            elif p.reply.status == 503:
+                host_down += 1
+            else:
+                failed += 1
+        rollup = gateway.rollup()
+        adm = np.asarray(admitted_ms, np.float64)
+
+        def _pct(q):
+            return round(float(np.percentile(adm, q)), 3) if adm.size \
+                else None
+
+        line = {
+            "metric": "fleet_admitted_latency_ms",
+            "value": _pct(50),
+            "unit": "ms",
+            "fast": bool(args.fast),
+            "arrival": args.arrival,
+            "rate": args.rate,
+            "deadline_ms": deadline_ms,
+            "requests": len(requests),
+            "ingest": ingest,
+            "wire_bytes_per_request": round(
+                wire_bytes / max(1, len(requests)), 1
+            ),
+            "open_loop_late_ms_max": round(late_ms_max, 3),
+            "backend": "fleet",
+            "fleet": {
+                "hosts": args.fleet,
+                "replicas_per_host": args.replicas or 1,
+                "emulate_device_ms": args.emulate_device_ms,
+                "killed_host": killed,
+                "admitted": len(admitted_ms),
+                "admitted_ms_p50": _pct(50),
+                "admitted_ms_p95": _pct(95),
+                "admitted_ms_p99": _pct(99),
+                "met_deadline": met,
+                "goodput_met_per_sec": (
+                    round(met / span_s, 3) if span_s > 0 else None
+                ),
+                "span_s": round(span_s, 3),
+                "shed": shed,
+                "host_down": host_down,
+                "failed": failed,
+                "stranded": stranded,
+                "rehomes": rollup["rehomes"],
+                "tripped_hosts": rollup["tripped_hosts"],
+                "fleet_adapt_ms_p99": rollup["adapt_ms_p99"],
+                "tenants": rollup["tenants"],
+                "dispatches": rollup["dispatches"],
+                "priority_spread": bool(args.priority_spread),
+            },
+        }
+        print(json.dumps(line))
+        exit_code = 0
+        server.close()
+    finally:
+        # Stop the health loop BEFORE killing hosts: otherwise the
+        # gateway observes the teardown SIGTERMs as host failures and
+        # logs spurious ``rehome`` records after the run is over.
+        gateway.close()
+        for host_id, proc in procs.items():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=30)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                proc.kill()
+        if sink is not None:
+            sink.close()
+    return exit_code
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="serve-bench",
@@ -552,6 +810,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "core(s) and cannot scale, but the "
                              "occupancy window overlaps perfectly. "
                              "0 (default) disables the shim")
+    parser.add_argument("--fleet", type=int, default=None, metavar="H",
+                        help="drive an H-HOST networked fleet through "
+                             "the HTTP gateway (serving/gateway.py): "
+                             "spawn H fleet-host processes (each its "
+                             "own ReplicaSet of --replicas width, "
+                             "default 1), put the admission-controlled "
+                             "gateway in front, and submit the OPEN-"
+                             "LOOP schedule through real sockets in the "
+                             "wire format. The line gains a `fleet` "
+                             "block (admitted/shed/rehome counts, "
+                             "client-observed admitted p99, goodput). "
+                             "Requires an open-loop --arrival")
+    parser.add_argument("--kill-host-at", type=int, default=None,
+                        metavar="K",
+                        help="SIGKILL the highest-ring-position fleet "
+                             "host when request K is submitted "
+                             "(requires --fleet): exercises between-"
+                             "sweep host death — in-flight requests "
+                             "must fail over to their re-homed host, "
+                             "never strand")
+    parser.add_argument("--priority-spread", action="store_true",
+                        help="cycle request priorities over the "
+                             "gateway's tiers (requires --fleet; "
+                             "default: every request rides tier 0)")
     parser.add_argument("--arrival", default="closed",
                         choices=["closed", "poisson", "bursty", "zipf"],
                         help="traffic discipline: 'closed' (default) "
@@ -609,11 +891,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.emulate_device_ms < 0:
         parser.error("--emulate-device-ms must be >= 0, got "
                      f"{args.emulate_device_ms}")
-    if args.emulate_device_ms and args.replicas is None:
-        parser.error("--emulate-device-ms requires --replicas (the "
-                     "device-occupancy shim emulates PER-REPLICA "
-                     "device blocking; it has no meaning on the "
-                     "single-engine closed loop)")
+    if (args.emulate_device_ms and args.replicas is None
+            and args.fleet is None):
+        parser.error("--emulate-device-ms requires --replicas or "
+                     "--fleet (the device-occupancy shim emulates "
+                     "PER-REPLICA device blocking; it has no meaning "
+                     "on the single-engine closed loop)")
     if args.arrival != "closed" and args.rate is None:
         parser.error("--arrival poisson|bursty|zipf is OPEN-LOOP and "
                      "needs its arrival process parameterized: pass "
@@ -637,7 +920,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "around the swap; combine it with the default "
                      "--arrival closed (mid-run rollover under open "
                      "loop is covered by the pool unit tests)")
-    if args.replicas is not None:
+    if args.fleet is not None:
+        if args.fleet < 1:
+            parser.error(f"--fleet must be >= 1, got {args.fleet}")
+        if args.arrival == "closed":
+            parser.error("--fleet is the networked OPEN-LOOP driver "
+                         "(real sockets, wall-clock arrivals): pick an "
+                         "open-loop --arrival and a --rate")
+        if args.replicas is not None and args.replicas < 1:
+            parser.error("--replicas (per-host pool width under "
+                         f"--fleet) must be >= 1, got {args.replicas}")
+        for flag, name in ((args.rollover, "--rollover"),
+                           (args.profile_request, "--profile-request"),
+                           (args.metrics_port, "--metrics-port"),
+                           (args.trace, "--trace"),
+                           (args.export_dir, "--export-dir")):
+            if flag:
+                parser.error(f"{name} applies to the in-process paths; "
+                             "the fleet hosts own their engines (drive "
+                             "them via the fleet-host flags instead)")
+    if args.kill_host_at is not None:
+        if args.fleet is None:
+            parser.error("--kill-host-at requires --fleet")
+        if args.kill_host_at < 0:
+            parser.error("--kill-host-at must be >= 0, got "
+                         f"{args.kill_host_at}")
+    if args.priority_spread and args.fleet is None:
+        parser.error("--priority-spread requires --fleet (priority "
+                     "tiers are a gateway admission concept)")
+    if args.replicas is not None and args.fleet is None:
         if args.replicas < 1:
             parser.error(f"--replicas must be >= 1, got {args.replicas}")
         # each replica needs its own disjoint device; on CPU force the
@@ -672,6 +983,14 @@ def main(argv: Optional[List[str]] = None) -> int:
               "open-loop --arrival or --replicas)",
               file=sys.stderr, flush=True)
         deadline_ms = None
+    if args.fleet is not None:
+        # the networked path never touches jax in THIS process: the
+        # hosts own the engines, the gateway/client/codec are stdlib +
+        # numpy (serving/gateway.py)
+        return _drive_fleet(
+            args, cfg, shots_buckets, n_requests, deadline_ms
+        )
+
     slo = None
     if deadline_ms is not None:
         from .metrics import SLOTracker

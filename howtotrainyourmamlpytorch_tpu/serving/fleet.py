@@ -1,0 +1,359 @@
+"""One fleet HOST process: a ``ReplicaSet`` behind a wire-frame HTTP
+server.
+
+The :mod:`serving.gateway` front tier puts N of these behind one
+endpoint. Each host is the full PR-15 serving stack — replicas,
+micro-batchers, adapted-params LRUs, the cache-affinity
+``ReplicaRouter`` — plus four HTTP surfaces:
+
+* ``POST /v1/serve`` — one wire-framed request (serving/gateway.py
+  codec) in, one framed ``TenantResult`` out. The host re-stamps the
+  request's deadline with the budget REMAINING after the edge
+  (``deadline_ms - gateway_elapsed_ms``) and records the edge share as
+  ``gateway_ms`` on the request, so the micro-batcher's
+  ``event='deadline'`` records attribute the network edge honestly
+  without any cross-host clock (only DURATIONS cross the wire, never
+  timestamps);
+* ``GET /healthz`` — 200 with ``{"ready": true, "queue_depth": N}``
+  once every replica is warmed (503 while warming) — the gateway's
+  membership poll reads both fields: readiness gates routing, depth
+  feeds admission control;
+* ``GET /stats``  — the router's placement stats + live queue depth;
+* ``GET /rollup`` — the pool rollup (per-replica breakdown + the
+  mergeable ``adapt_ms_hist`` / ``queue_ms_hist`` the gateway's fleet
+  rollup merges exactly).
+
+``python -m howtotrainyourmamlpytorch_tpu.serving.fleet`` runs one host
+standalone (the serve-bench ``--fleet N`` driver spawns N of them):
+it prints one ``{"host_ready": true, "port": ..., "host_id": ...}``
+JSON line on stdout once warmed, then serves until SIGTERM/SIGINT.
+
+``FleetHost`` itself is jax-free (it duck-types the router/pool
+surfaces), so the gateway tests drive it against stub pools; only
+``main()`` builds real engines.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .gateway import (
+    WIRE_CONTENT_TYPE,
+    WireError,
+    decode_request,
+    encode_result,
+)
+from .router import AllReplicasUnhealthyError
+
+
+class FleetHost:
+    """The HTTP face of one host's router + pool.
+
+    :param router: a ``ReplicaRouter`` (or stub) — ``submit(request)``
+        returning a pending with ``get(timeout)``.
+    :param pool: a ``ReplicaSet`` (or stub) — ``readiness()`` /
+        ``rollup()`` / ``replicas`` with ``queue_depth()``.
+    :param sink: optional telemetry sink (closed by the OWNER, not the
+        host — the host only serves).
+    :param host_id: this member's stable fleet identity (ring position
+        comes from the gateway's sorted id list).
+    """
+
+    def __init__(self, router, pool, sink=None,
+                 host_id: str = "host0", port: int = 0,
+                 bind: str = "127.0.0.1",
+                 default_timeout_s: float = 600.0):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        self.router = router
+        self.pool = pool
+        self.sink = sink
+        self.host_id = str(host_id)
+        self.default_timeout_s = float(default_timeout_s)
+        host_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, status: int, ctype: str,
+                      body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, status: int, payload: Any) -> None:
+                self._send(
+                    status, "application/json",
+                    json.dumps(payload).encode(),
+                )
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                if self.path != "/v1/serve":
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                status, ctype, payload = host_self.handle_serve(body)
+                self._send(status, ctype, payload)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path == "/healthz":
+                    ready = host_self.ready()
+                    self._send_json(200 if ready else 503, {
+                        "ready": ready,
+                        "host_id": host_self.host_id,
+                        "queue_depth": host_self.queue_depth(),
+                    })
+                elif self.path == "/stats":
+                    self._send_json(200, host_self.stats())
+                elif self.path == "/rollup":
+                    self._send_json(200, host_self.pool.rollup())
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+            def log_message(self, fmt, *args):  # noqa: A003 - silence
+                pass
+
+        self._server = ThreadingHTTPServer((bind, int(port)), Handler)
+        self.port = self._server.server_address[1]
+        self.url = f"http://{bind}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"fleet-host-{self.host_id}", daemon=True,
+        )
+        self._thread.start()
+
+    # -- surfaces ---------------------------------------------------------
+
+    def ready(self) -> bool:
+        readiness = getattr(self.pool, "readiness", None)
+        if readiness is None:
+            return True
+        return all(readiness().values())
+
+    def queue_depth(self) -> int:
+        depth = 0
+        for r in getattr(self.pool, "replicas", []):
+            depth += int(r.queue_depth())
+        return depth
+
+    def stats(self) -> Dict[str, Any]:
+        stats = getattr(self.router, "stats", None)
+        out = dict(stats()) if stats is not None else {}
+        out["host_id"] = self.host_id
+        out["queue_depth"] = self.queue_depth()
+        return out
+
+    def handle_serve(self, body: bytes):
+        """Decode, re-stamp the deadline with the post-edge remainder,
+        submit through the affinity router, and frame the result.
+        Typed failures: 400 (malformed frame/geometry), 429 (budget
+        already spent at the edge — the gateway's shed estimate raced a
+        slow forward), 503 (every replica tripped — the host is dying
+        and the gateway's next contact trips it), 504 (timeout)."""
+        t0 = time.perf_counter()
+        try:
+            request, header = decode_request(body)
+        except WireError as e:
+            return 400, "application/json", json.dumps(
+                {"error": "bad_request", "detail": str(e)}
+            ).encode()
+        gateway_ms = header.get("gateway_elapsed_ms")
+        if gateway_ms is not None:
+            request.gateway_ms = float(gateway_ms)
+        priority = header.get("priority")
+        if priority is not None:
+            request.priority = int(priority)
+        if request.deadline_ms is not None and gateway_ms is not None:
+            remaining = float(request.deadline_ms) - float(gateway_ms)
+            if remaining <= 0:
+                return 429, "application/json", json.dumps({
+                    "error": "shed", "reason": "deadline",
+                    "where": "host",
+                    "detail": "deadline budget spent before arrival",
+                }).encode()
+            request.deadline_ms = remaining
+        try:
+            pending = self.router.submit(request)
+        except (ValueError, TypeError) as e:
+            return 400, "application/json", json.dumps(
+                {"error": "bad_request", "detail": str(e)}
+            ).encode()
+        except AllReplicasUnhealthyError as e:
+            return 503, "application/json", json.dumps({
+                "error": "host_unhealthy",
+                "detail": str(e),
+                "causes": [repr(c) for c in e.causes],
+            }).encode()
+        timeout = self.default_timeout_s
+        try:
+            result = pending.get(timeout=timeout)
+        except TimeoutError:
+            return 504, "application/json", json.dumps({
+                "error": "timeout",
+                "detail": f"request not served within {timeout}s",
+            }).encode()
+        except Exception as e:  # noqa: BLE001 - relayed typed, chained
+            return 500, "application/json", json.dumps({
+                "error": "dispatch_failed",
+                "detail": repr(e),
+                "cause": repr(e.__cause__) if e.__cause__ else None,
+            }).encode()
+        host_ms = (time.perf_counter() - t0) * 1e3
+        frame = encode_result(
+            result, host_id=self.host_id, host_ms=round(host_ms, 3),
+        )
+        return 200, WIRE_CONTENT_TYPE, frame
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+# -- standalone host process -------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Run one fleet host standalone (the ``--fleet`` driver's child
+    process). Prints a single readiness JSON line on stdout once the
+    pool is warmed, then serves until SIGTERM/SIGINT."""
+    import argparse
+    import os
+    import signal
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="fleet-host",
+        description="One fleet host: a ReplicaSet + affinity router "
+                    "behind the wire-frame HTTP serving endpoint",
+    )
+    parser.add_argument("--fast", action="store_true",
+                        help="the small deterministic serving config "
+                             "(the CI fleet shape)")
+    parser.add_argument("--config", default=None,
+                        help="experiment JSON supplying the geometry "
+                             "and serving_* knobs")
+    parser.add_argument("--host-id", default="host0",
+                        help="this member's stable fleet identity")
+    parser.add_argument("--port", type=int, default=0,
+                        help="bind port (0 = ephemeral, printed on the "
+                             "readiness line)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="pool width on this host")
+    parser.add_argument("--ingest", default=None,
+                        choices=("f32", "uint8", "index"),
+                        help="override cfg.serving_ingest")
+    parser.add_argument("--cache-size", type=int, default=None,
+                        help="override cfg.serving_adapted_cache_size")
+    parser.add_argument("--emulate-device-ms", type=float, default=0.0,
+                        help="per-dispatch device-occupancy emulation "
+                             "(serving/bench.py shim)")
+    parser.add_argument("--telemetry", default=None, metavar="PATH",
+                        help="this host's telemetry JSONL (deadline/"
+                             "serving records; `cli slo --fleet` merges "
+                             "the per-host logs)")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    if args.replicas < 1:
+        parser.error(f"--replicas must be >= 1, got {args.replicas}")
+    if args.emulate_device_ms < 0:
+        parser.error("--emulate-device-ms must be >= 0, got "
+                     f"{args.emulate_device_ms}")
+    # one virtual CPU device per replica, forced before jax first loads
+    # (the serve-bench --replicas pattern)
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{args.replicas}"
+            ).strip()
+
+    from ..core import maml
+    from .bench import (
+        _bench_cfg,
+        _DeviceOccupancyShim,
+        _synth_store,
+        bench_shots_buckets,
+    )
+    from .replica import ReplicaSet
+    from .router import ReplicaRouter
+
+    cfg = _bench_cfg(args)
+    shots_buckets = bench_shots_buckets(cfg)
+    state = maml.init_state(cfg)
+    sink = None
+    if args.telemetry:
+        from ..telemetry.sinks import JsonlSink
+
+        sink = JsonlSink(args.telemetry)
+    ingest = args.ingest or cfg.serving_ingest
+    cache_size = (
+        cfg.serving_adapted_cache_size if args.cache_size is None
+        else args.cache_size
+    )
+    store = _synth_store(cfg) if ingest == "index" else None
+    import jax
+
+    pool_devices = None
+    if (jax.default_backend() == "cpu"
+            and len(jax.devices()) > args.replicas):
+        pool_devices = list(jax.devices())[:args.replicas]
+    pool = ReplicaSet(
+        cfg, state, n_replicas=args.replicas, devices=pool_devices,
+        shots_buckets=shots_buckets, sink=sink, strict_retrace=True,
+        ingest=ingest, store=store, cache_size=cache_size,
+    )
+    pool.warmup()
+    if args.emulate_device_ms:
+        for r in pool.replicas:
+            r.engine = _DeviceOccupancyShim(
+                r.engine, args.emulate_device_ms
+            )
+    router = ReplicaRouter(
+        pool, spill_depth=cfg.serving_router_spill_depth
+    )
+    host = FleetHost(
+        router, pool, sink=sink, host_id=args.host_id, port=args.port
+    )
+    print(json.dumps({
+        "host_ready": True,
+        "host_id": host.host_id,
+        "port": host.port,
+        "replicas": args.replicas,
+        "ingest": ingest,
+    }), flush=True)
+
+    stop = threading.Event()
+
+    def _drain(signum, frame):  # noqa: ARG001 - signal API
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    stop.wait()
+    # graceful exit: final rollup record (histograms included) before
+    # the pool drains — a SIGKILLed host simply doesn't get one, which
+    # is exactly the forensic difference the fleet logs should show
+    try:
+        pool.rollup()
+    except Exception:  # noqa: BLE001 - shutdown best-effort
+        pass
+    host.close()
+    pool.close()
+    if sink is not None:
+        sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

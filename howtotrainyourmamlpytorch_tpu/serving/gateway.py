@@ -1,0 +1,846 @@
+"""The networked fleet front tier: one endpoint over N host processes.
+
+PR 15's ``ReplicaRouter`` scales serving across replicas in one process;
+this module lifts the same affinity/trip/re-home semantics one level, to
+a fleet of HOST processes (each running a full ``ReplicaSet`` behind
+:mod:`serving.fleet`), and adds the SLO *enforcement* the pool only
+measures:
+
+* **Wire schema** — one framed binary format for requests and
+  responses: a 4-byte big-endian header length, a JSON header (kind /
+  tenant / deadline / priority / array manifest), then the raw C-order
+  array buffers concatenated in manifest order. The arrays ship in the
+  PR-13 ingest encodings, so the compression the device ingest tiers
+  bought applies ON THE WIRE too: uint8 support sets are ~4x smaller
+  than f32, and an index request against a fleet-resident store is a
+  few hundred bytes of int32 rows.
+* **Fleet-wide cache affinity** — ``home_host`` hashes the SAME
+  ``batcher.update_support_digest`` content fingerprint the in-process
+  router and the engine's adapted-params cache key use
+  (``router.request_fingerprint``), over the sorted host-id ring. A
+  tenant's adapted-params LRU entry therefore lives on exactly one
+  host, fleet-wide, and routing identity can never drift from cache
+  identity — one recipe, three consumers.
+* **Admission control** — a request is REJECTED AT THE EDGE with a
+  typed response (HTTP 429, ``reason='admission'``) when its home
+  host's load estimate (last-polled queue depth + the gateway's own
+  in-flight count) reaches the per-host budget
+  (``serving_gateway_queue_budget``), right-shifted by the request's
+  priority tier (tier 0 keeps the full budget, tier 1 half, ...).
+* **Deadline-aware shedding** — a deadline-carrying request whose
+  budget cannot cover the home host's current queue estimate
+  (load x an EWMA of observed host service time — conservative by
+  construction: the EWMA includes host queue wait, so overload sheds
+  harder and self-corrects as the queue drains) is rejected typed
+  (``reason='deadline'``) instead of joining a queue it can only
+  collapse. Both shed shapes emit ``gateway`` telemetry records
+  (schema v13).
+* **Health-checked membership + deterministic re-homing** — a
+  background thread polls each host's ``/healthz``; a host that stops
+  answering AFTER it was ready is tripped (latched, PR-15 semantics:
+  never-ready hosts are skipped, not tripped). The ring POSITIONS are
+  fixed at construction, so losing host k deterministically re-homes
+  exactly k's tenants to the next ready host on the ring — every other
+  home assignment is untouched. A host that dies BETWEEN sweeps is
+  caught at forward time: the in-flight socket request fails
+  immediately with the chained root cause (the PR-13/15
+  batcher-crash semantics at the network layer), the host is tripped,
+  and the request is retried on its re-homed host — adapt-on-request
+  is a pure function of (support, query, snapshot), so the retry is
+  idempotent; only a fleet with NO ready host left returns the typed
+  ``host_down`` failure (HTTP 503, root causes chained in the body).
+* **Fleet rollup** — ``rollup()`` fetches every ready host's
+  ``/rollup`` and merges the per-host ``LogHistogram`` buckets EXACTLY
+  (serving/metrics.py — the PR-17 mergeable-histogram machinery), so
+  fleet p99 and burn rates come from one histogram family, never from
+  averaged percentiles.
+
+Everything here is stdlib + numpy — importable (and testable) without
+jax, like the router it extends.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batcher import AdaptRequest, IndexRequest
+from .router import home_replica, request_fingerprint
+
+#: request/response content type for the framed binary wire format
+WIRE_CONTENT_TYPE = "application/x-maml-wire"
+
+
+class WireError(ValueError):
+    """A frame that cannot be decoded (truncated, bad manifest, short
+    buffers) — the gateway answers HTTP 400, never a stack trace."""
+
+
+class HostDownError(RuntimeError):
+    """No ready host left to serve a request; ``__cause__`` chains the
+    last forward failure's root cause (the network-layer twin of the
+    batcher's worker-crash chaining)."""
+
+
+# -- wire codec --------------------------------------------------------------
+
+
+def _encode_frame(header: Dict[str, Any],
+                  buffers: Sequence[bytes]) -> bytes:
+    hb = json.dumps(header).encode("utf-8")
+    return struct.pack(">I", len(hb)) + hb + b"".join(buffers)
+
+
+def _decode_frame(payload: bytes) -> Tuple[Dict[str, Any], bytes]:
+    """Split a frame into (header, concatenated buffer blob)."""
+    if len(payload) < 4:
+        raise WireError(
+            f"wire frame truncated: {len(payload)} bytes, need >= 4"
+        )
+    (hlen,) = struct.unpack_from(">I", payload)
+    if len(payload) < 4 + hlen:
+        raise WireError(
+            f"wire frame truncated: header says {hlen} bytes, frame "
+            f"holds {len(payload) - 4}"
+        )
+    try:
+        header = json.loads(payload[4:4 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"wire header is not valid JSON: {e}") from e
+    if not isinstance(header, dict):
+        raise WireError(
+            f"wire header must be an object, got "
+            f"{type(header).__name__}"
+        )
+    return header, payload[4 + hlen:]
+
+
+def _array_manifest(named: Sequence[Tuple[str, np.ndarray]]) -> Tuple[
+        List[Dict[str, Any]], List[bytes]]:
+    manifest, buffers = [], []
+    for name, arr in named:
+        arr = np.ascontiguousarray(arr)
+        manifest.append({
+            "name": name,
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+        })
+        buffers.append(arr.tobytes())
+    return manifest, buffers
+
+
+def _decode_arrays(header: Dict[str, Any],
+                   blob: bytes) -> Dict[str, np.ndarray]:
+    arrays: Dict[str, np.ndarray] = {}
+    at = 0
+    for spec in header.get("arrays", []):
+        try:
+            dtype = np.dtype(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+            name = spec["name"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f"bad array manifest entry {spec!r}") from e
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if at + nbytes > len(blob):
+            raise WireError(
+                f"wire buffers truncated: array {name!r} needs "
+                f"{nbytes} bytes at offset {at}, blob holds {len(blob)}"
+            )
+        # copy: frombuffer views are read-only and would pin the whole
+        # request body alive behind every small array
+        arrays[name] = np.frombuffer(
+            blob, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
+            offset=at,
+        ).copy().reshape(shape)
+        at += nbytes
+    return arrays
+
+
+def encode_request(request) -> bytes:
+    """One request as a wire frame. Index requests ship only their
+    int32 row tensors (<1KB against a fleet-resident store); pixel
+    requests ship their support/query arrays in the ingest dtype the
+    engine expects (uint8 stays uint8 — the wire inherits the ~4x
+    ingest compression)."""
+    header: Dict[str, Any] = {
+        "tenant_id": getattr(request, "tenant_id", None),
+        "deadline_ms": getattr(request, "deadline_ms", None),
+    }
+    priority = getattr(request, "priority", None)
+    if priority is not None:
+        header["priority"] = int(priority)
+    if getattr(request, "support_idx", None) is not None:
+        header["kind"] = "index"
+        header["labeled"] = bool(request.labeled)
+        named = [
+            ("support_idx", np.asarray(request.support_idx, np.int32)),
+            ("query_idx", np.asarray(request.query_idx, np.int32)),
+        ]
+    else:
+        header["kind"] = "adapt"
+        named = [
+            ("support_x", np.asarray(request.support_x)),
+            ("support_y", np.asarray(request.support_y)),
+            ("query_x", np.asarray(request.query_x)),
+        ]
+        if request.query_y is not None:
+            named.append(("query_y", np.asarray(request.query_y)))
+    header["arrays"], buffers = _array_manifest(named)
+    return _encode_frame(header, buffers)
+
+
+def decode_request(payload: bytes) -> Tuple[Any, Dict[str, Any]]:
+    """Decode a wire frame back into an ``AdaptRequest`` /
+    ``IndexRequest`` plus its raw header (the gateway-path fields —
+    ``gateway_elapsed_ms``, clamped ``priority`` — ride the header; the
+    HOST decides how they land on the request, see serving/fleet.py)."""
+    header, blob = _decode_frame(payload)
+    arrays = _decode_arrays(header, blob)
+    kind = header.get("kind")
+    try:
+        if kind == "index":
+            request = IndexRequest(
+                support_idx=arrays["support_idx"],
+                query_idx=arrays["query_idx"],
+                labeled=bool(header.get("labeled", True)),
+                tenant_id=header.get("tenant_id"),
+                deadline_ms=header.get("deadline_ms"),
+            )
+        elif kind == "adapt":
+            request = AdaptRequest(
+                support_x=arrays["support_x"],
+                support_y=arrays["support_y"],
+                query_x=arrays["query_x"],
+                query_y=arrays.get("query_y"),
+                tenant_id=header.get("tenant_id"),
+                deadline_ms=header.get("deadline_ms"),
+            )
+        else:
+            raise WireError(
+                f"wire header kind must be 'adapt' or 'index', got "
+                f"{kind!r}"
+            )
+    except KeyError as e:
+        raise WireError(
+            f"wire frame of kind {kind!r} is missing array {e}"
+        ) from e
+    return request, header
+
+
+def encode_result(result, **extra: Any) -> bytes:
+    """One ``TenantResult`` as a response frame (predictions as a raw
+    buffer, scalars + host timings in the header)."""
+    preds = np.ascontiguousarray(np.asarray(result.preds))
+    header: Dict[str, Any] = {
+        "ok": True,
+        "tenant_id": result.tenant_id,
+        "loss": None if result.loss is None else float(result.loss),
+        "accuracy": (
+            None if result.accuracy is None else float(result.accuracy)
+        ),
+        **extra,
+    }
+    header["arrays"], buffers = _array_manifest([("preds", preds)])
+    return _encode_frame(header, buffers)
+
+
+def decode_result(payload: bytes) -> Dict[str, Any]:
+    """Decode a response frame into its header dict with ``preds``
+    attached as an ndarray."""
+    header, blob = _decode_frame(payload)
+    out = dict(header)
+    out.update(_decode_arrays(header, blob))
+    return out
+
+
+# -- the consistent-hash host ring -------------------------------------------
+
+
+def home_host(fingerprint: str, hosts: Sequence[str]) -> str:
+    """The fleet-level home assignment: the SAME modular arithmetic as
+    ``router.home_replica``, over the sorted host-id ring — so the
+    (fingerprint -> home) map is a pure function of the content digest
+    and the membership set, stable across processes and restarts (the
+    cross-process twin of the router's fingerprint stability test)."""
+    ring = sorted(str(h) for h in hosts)
+    return ring[home_replica(fingerprint, len(ring))]
+
+
+# -- gateway -----------------------------------------------------------------
+
+
+@dataclass
+class _HostHandle:
+    """One fleet member as the gateway sees it."""
+
+    host_id: str
+    address: str  # "host:port"
+    #: answered /healthz 200 at the last contact — routable now
+    ready: bool = False
+    #: was EVER ready — the trip gate (a host that never came up is
+    #: skipped, not tripped: the PR-15 not-yet-warmed semantics)
+    was_ready: bool = False
+    #: latched once the host is declared dead; never un-trips
+    tripped: bool = False
+    trip_cause: Optional[BaseException] = None
+    #: last-polled host queue depth (the admission signal's slow term)
+    depth: int = 0
+    #: gateway-side in-flight count (the admission signal's live term)
+    in_flight: int = 0
+    #: EWMA of observed host service time (ms) — the deadline-shed
+    #: queue-estimate multiplier; None until the first response
+    ewma_ms: Optional[float] = None
+
+    def conn(self, timeout: float) -> http.client.HTTPConnection:
+        host, _, port = self.address.rpartition(":")
+        return http.client.HTTPConnection(
+            host, int(port), timeout=timeout
+        )
+
+
+@dataclass
+class _Shed:
+    """A typed edge rejection (never an exception: sheds are the
+    gateway WORKING, not failing)."""
+
+    reason: str  # 'admission' | 'deadline'
+    host: str
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+
+class Gateway:
+    """The fleet front door: affinity routing + admission control +
+    deadline shedding + health-checked membership over N host
+    processes.
+
+    :param cfg: a ``MAMLConfig`` for the gateway knobs
+        (``serving_gateway_queue_budget`` / ``_priority_tiers`` /
+        ``_health_interval_s``).
+    :param hosts: the fleet membership — ``{host_id: "addr:port"}`` (or
+        a sequence of ``"addr:port"`` strings, ids assigned
+        ``host0..hostN-1`` in the given order). Membership is fixed for
+        the gateway's lifetime; ring positions come from the SORTED
+        host ids.
+    :param sink: optional telemetry sink for the schema-v13 ``gateway``
+        records (shed / rehome / rollup).
+    :param start_health_loop: start the background /healthz poller
+        (pass False in tests that drive ``poll_once()`` by hand).
+    """
+
+    def __init__(self, cfg, hosts, sink=None,
+                 start_health_loop: bool = True,
+                 connect_timeout_s: float = 2.0,
+                 request_timeout_s: float = 600.0):
+        if isinstance(hosts, dict):
+            members = {str(k): str(v) for k, v in hosts.items()}
+        else:
+            members = {
+                f"host{i}": str(addr) for i, addr in enumerate(hosts)
+            }
+        if not members:
+            raise ValueError("Gateway needs at least one host")
+        self.cfg = cfg
+        self.sink = sink
+        self.queue_budget = int(cfg.serving_gateway_queue_budget)
+        self.priority_tiers = int(cfg.serving_gateway_priority_tiers)
+        self.health_interval_s = float(
+            cfg.serving_gateway_health_interval_s
+        )
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        #: ring order is the sorted host-id list — fixed at
+        #: construction, so home assignments and re-homing are
+        #: deterministic for the fleet's whole life
+        self.ring: List[_HostHandle] = [
+            _HostHandle(host_id=hid, address=members[hid])
+            for hid in sorted(members)
+        ]
+        self._lock = threading.Lock()
+        self.admitted = 0
+        self.shed: Dict[str, int] = {"admission": 0, "deadline": 0}
+        self.rehomes = 0
+        self.forward_failures = 0
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        if start_health_loop:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="gateway-health",
+                daemon=True,
+            )
+            self._health_thread.start()
+
+    # -- membership / health ---------------------------------------------
+
+    def _record(self, **fields: Any) -> None:
+        if self.sink is None:
+            return
+        from ..telemetry.sinks import make_record
+
+        self.sink.write(make_record("gateway", **fields))
+
+    def _get_json(self, h: _HostHandle, path: str,
+                  timeout: float) -> Tuple[int, Any]:
+        conn = h.conn(timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+        finally:
+            conn.close()
+        try:
+            return resp.status, json.loads(body)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return resp.status, None
+
+    def poll_once(self) -> None:
+        """One health sweep: refresh readiness + queue depth for every
+        untripped host; a host that stops answering AFTER it was ready
+        is tripped (latched). Never-ready hosts are left unready, not
+        tripped — they may still be warming up."""
+        for h in self.ring:
+            if h.tripped:
+                continue
+            try:
+                status, payload = self._get_json(
+                    h, "/healthz", self.connect_timeout_s
+                )
+            except (OSError, http.client.HTTPException) as e:
+                if h.was_ready:
+                    self._trip(h, e)
+                continue
+            with self._lock:
+                h.ready = status == 200
+                if h.ready:
+                    h.was_ready = True
+                if isinstance(payload, dict):
+                    h.depth = int(payload.get("queue_depth", h.depth))
+
+    def _health_loop(self) -> None:
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.health_interval_s)
+
+    def wait_ready(self, timeout_s: float = 60.0,
+                   min_hosts: Optional[int] = None) -> None:
+        """Block until ``min_hosts`` (default: all) hosts answer
+        /healthz 200 — the fleet-level warmup barrier."""
+        need = len(self.ring) if min_hosts is None else int(min_hosts)
+        deadline = time.perf_counter() + float(timeout_s)
+        while True:
+            self.poll_once()
+            ready = sum(1 for h in self.ring if h.ready)
+            if ready >= need:
+                return
+            if time.perf_counter() >= deadline:
+                raise TimeoutError(
+                    f"only {ready}/{need} fleet hosts ready within "
+                    f"{timeout_s}s: "
+                    + ", ".join(
+                        f"{h.host_id}={'ready' if h.ready else 'down'}"
+                        for h in self.ring
+                    )
+                )
+            time.sleep(min(0.05, self.health_interval_s))
+
+    def _trip(self, h: _HostHandle, cause: BaseException) -> bool:
+        """Latch ``h`` out of the ring; True only on the tripping
+        transition (every later failure observes it already dead —
+        exactly one ``rehome`` record per lost host)."""
+        with self._lock:
+            if h.tripped:
+                return False
+            h.tripped = True
+            h.ready = False
+            h.trip_cause = cause
+            stranded = h.in_flight
+            self.rehomes += 1
+        self._record(
+            event="rehome", host=h.host_id, cause=repr(cause),
+            in_flight=stranded,
+        )
+        return True
+
+    # -- routing + admission ---------------------------------------------
+
+    def _pick(self, home_idx: int) -> Optional[_HostHandle]:
+        """Ring walk from the home POSITION (computed over the full
+        fixed membership, so healthy hosts' homes never reshuffle when
+        another host trips) to the first ready host; None when the
+        whole ring is down."""
+        n = len(self.ring)
+        with self._lock:
+            for step in range(n):
+                h = self.ring[(home_idx + step) % n]
+                if h.ready and not h.tripped:
+                    return h
+        return None
+
+    def _admission(self, h: _HostHandle, priority: int,
+                   deadline_ms: Optional[float]) -> Optional[_Shed]:
+        """The edge decision for one request against its home host:
+        None admits; a ``_Shed`` names the typed rejection."""
+        with self._lock:
+            load = h.depth + h.in_flight
+            ewma = h.ewma_ms
+        budget = max(1, self.queue_budget >> priority)
+        if load >= budget:
+            return _Shed(
+                reason="admission", host=h.host_id,
+                detail={"load": load, "budget": budget},
+            )
+        if deadline_ms is not None and ewma is not None:
+            est_ms = load * ewma
+            if float(deadline_ms) <= est_ms:
+                return _Shed(
+                    reason="deadline", host=h.host_id,
+                    detail={
+                        "queue_est_ms": round(est_ms, 3),
+                        "load": load,
+                        "ewma_ms": round(ewma, 3),
+                    },
+                )
+        return None
+
+    def handle_serve(self, body: bytes) -> Tuple[int, str, bytes]:
+        """Serve one wire-framed request end to end; returns
+        ``(http_status, content_type, response_body)``. 200 carries the
+        host's response frame verbatim; everything else is typed JSON
+        (shed / host_down / bad_request) — a client can always tell WHY
+        it was refused."""
+        t_edge = time.perf_counter()
+        try:
+            request, header = decode_request(body)
+            fingerprint = request_fingerprint(request)
+        except (WireError, ValueError, TypeError) as e:
+            return 400, "application/json", json.dumps(
+                {"error": "bad_request", "detail": str(e)}
+            ).encode()
+        priority = int(header.get("priority") or 0)
+        priority = min(max(priority, 0), self.priority_tiers - 1)
+        deadline_ms = header.get("deadline_ms")
+        home_idx = home_replica(fingerprint, len(self.ring))
+        hlen = struct.unpack_from(">I", body)[0]
+        blob = body[4 + hlen:]
+        causes: List[BaseException] = []
+        while True:
+            host = self._pick(home_idx)
+            if host is None:
+                err = HostDownError(
+                    "no ready fleet host left for this request (root "
+                    "cause chained below)"
+                )
+                if causes:
+                    err.__cause__ = causes[-1]
+                return 503, "application/json", json.dumps({
+                    "error": "host_down",
+                    "detail": str(err),
+                    "cause": repr(causes[-1]) if causes else None,
+                    "causes": [repr(c) for c in causes],
+                }).encode()
+            shed = self._admission(host, priority, deadline_ms)
+            if shed is not None:
+                with self._lock:
+                    self.shed[shed.reason] += 1
+                self._record(
+                    event="shed", reason=shed.reason,
+                    tenant_id=header.get("tenant_id"),
+                    priority=priority, deadline_ms=deadline_ms,
+                    host=shed.host, **shed.detail,
+                )
+                return 429, "application/json", json.dumps({
+                    "error": "shed", "reason": shed.reason,
+                    "host": shed.host, **shed.detail,
+                }).encode()
+            # re-stamp the edge share per attempt (retries after a trip
+            # have spent more of the budget) and forward the ORIGINAL
+            # buffer bytes — the arrays are never re-encoded
+            fwd_header = dict(header)
+            fwd_header["priority"] = priority
+            fwd_header["gateway_elapsed_ms"] = round(
+                (time.perf_counter() - t_edge) * 1e3, 3
+            )
+            fwd = _encode_frame(fwd_header, [blob])
+            with self._lock:
+                host.in_flight += 1
+            t_fwd = time.perf_counter()
+            try:
+                conn = host.conn(self.request_timeout_s)
+                try:
+                    conn.request(
+                        "POST", "/v1/serve", body=fwd,
+                        headers={"Content-Type": WIRE_CONTENT_TYPE},
+                    )
+                    resp = conn.getresponse()
+                    status, payload = resp.status, resp.read()
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException) as e:
+                # the between-sweeps death path: fail fast, trip, and
+                # re-home THIS request on the ring walk (idempotent by
+                # construction) instead of stranding it on a socket
+                with self._lock:
+                    host.in_flight -= 1
+                    self.forward_failures += 1
+                causes.append(e)
+                self._trip(host, e)
+                continue
+            rtt_ms = (time.perf_counter() - t_fwd) * 1e3
+            with self._lock:
+                host.in_flight -= 1
+                if status == 200:
+                    self.admitted += 1
+                    host.ewma_ms = (
+                        rtt_ms if host.ewma_ms is None
+                        else 0.7 * host.ewma_ms + 0.3 * rtt_ms
+                    )
+            ctype = WIRE_CONTENT_TYPE if status == 200 else (
+                "application/json"
+            )
+            return status, ctype, payload
+
+    # -- fleet surfaces ---------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "hosts": [
+                    {
+                        "host_id": h.host_id,
+                        "address": h.address,
+                        "ready": h.ready,
+                        "tripped": h.tripped,
+                        "trip_cause": (
+                            repr(h.trip_cause) if h.trip_cause else None
+                        ),
+                        "depth": h.depth,
+                        "in_flight": h.in_flight,
+                        "ewma_ms": (
+                            round(h.ewma_ms, 3) if h.ewma_ms is not None
+                            else None
+                        ),
+                    }
+                    for h in self.ring
+                ],
+                "admitted": self.admitted,
+                "shed": dict(self.shed),
+                "rehomes": self.rehomes,
+                "forward_failures": self.forward_failures,
+            }
+
+    def rollup(self) -> Dict[str, Any]:
+        """The fleet aggregate: per-host rollups fetched live, their
+        log histograms merged EXACTLY bucket-by-bucket (the same
+        ladder, enforced by ``LogHistogram.merge``), plus the
+        gateway-side admission counters. Emits one ``gateway``
+        ``event='rollup'`` record when a sink is wired."""
+        from .metrics import LogHistogram
+
+        merged = {
+            "adapt_ms_hist": LogHistogram(),
+            "queue_ms_hist": LogHistogram(),
+        }
+        per_host: List[Dict[str, Any]] = []
+        tenants = dispatches = 0
+        for h in self.ring:
+            if not h.ready or h.tripped:
+                continue
+            try:
+                status, payload = self._get_json(
+                    h, "/rollup", self.request_timeout_s
+                )
+            except (OSError, http.client.HTTPException) as e:
+                self._trip(h, e)
+                continue
+            if status != 200 or not isinstance(payload, dict):
+                continue
+            per_host.append({"host_id": h.host_id, **payload})
+            tenants += int(payload.get("tenants", 0))
+            dispatches += int(payload.get("dispatches", 0))
+            for key, hist in merged.items():
+                if payload.get(key):
+                    hist.merge(LogHistogram.from_dict(payload[key]))
+        with self._lock:
+            out: Dict[str, Any] = {
+                "hosts": len(self.ring),
+                "ready_hosts": sum(
+                    1 for h in self.ring if h.ready and not h.tripped
+                ),
+                "tripped_hosts": [
+                    h.host_id for h in self.ring if h.tripped
+                ],
+                "admitted": self.admitted,
+                "shed": dict(self.shed),
+                "rehomes": self.rehomes,
+            }
+        out.update(
+            tenants=tenants,
+            dispatches=dispatches,
+            adapt_ms_p99=merged["adapt_ms_hist"].quantile(0.99),
+            adapt_ms_hist=merged["adapt_ms_hist"].to_dict(),
+            queue_ms_hist=merged["queue_ms_hist"].to_dict(),
+            per_host=per_host,
+        )
+        rec = {k: v for k, v in out.items() if k != "per_host"}
+        self._record(event="rollup", **rec)
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+            self._health_thread = None
+
+
+# -- the gateway's own HTTP face ---------------------------------------------
+
+
+class GatewayServer:
+    """The one fleet endpoint: POST ``/v1/serve`` (wire frames in/out),
+    GET ``/healthz`` (200 once >= 1 host is ready — the fleet is
+    serving), GET ``/stats`` (membership + admission counters), GET
+    ``/rollup`` (the exact-merge fleet aggregate). ``port=0`` binds an
+    ephemeral port (the CI shape); stdlib ``ThreadingHTTPServer``, one
+    thread per connection, same as serving/metrics.py."""
+
+    def __init__(self, gateway: Gateway, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        gw = gateway
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, status: int, ctype: str,
+                      body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                if self.path != "/v1/serve":
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                status, ctype, payload = gw.handle_serve(body)
+                self._send(status, ctype, payload)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path == "/healthz":
+                    ready = any(
+                        h.ready and not h.tripped for h in gw.ring
+                    )
+                    body = json.dumps({
+                        "ready": ready,
+                        "hosts": {
+                            h.host_id: h.ready and not h.tripped
+                            for h in gw.ring
+                        },
+                    }).encode()
+                    self._send(
+                        200 if ready else 503, "application/json", body
+                    )
+                elif self.path == "/stats":
+                    self._send(
+                        200, "application/json",
+                        json.dumps(gw.stats()).encode(),
+                    )
+                elif self.path == "/rollup":
+                    self._send(
+                        200, "application/json",
+                        json.dumps(gw.rollup()).encode(),
+                    )
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+            def log_message(self, fmt, *args):  # noqa: A003 - silence
+                pass
+
+        self.gateway = gateway
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="gateway-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+@dataclass
+class GatewayReply:
+    """One request's fate at the fleet edge, decoded."""
+
+    status: int
+    #: the decoded response frame (preds + scalars + host timings) on
+    #: 200; None otherwise
+    result: Optional[Dict[str, Any]] = None
+    #: the typed JSON body on any non-200 (shed / host_down / ...)
+    error: Optional[Dict[str, Any]] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 200
+
+    @property
+    def shed_reason(self) -> Optional[str]:
+        if self.error is not None and self.error.get("error") == "shed":
+            return self.error.get("reason")
+        return None
+
+
+class GatewayClient:
+    """A minimal wire client: encode, POST, decode — what serve-bench's
+    ``--fleet`` driver and the tests speak."""
+
+    def __init__(self, address: str, timeout_s: float = 600.0):
+        self.address = str(address)
+        self.timeout_s = float(timeout_s)
+
+    def serve(self, request) -> GatewayReply:
+        return self.serve_frame(encode_request(request))
+
+    def serve_frame(self, body: bytes) -> GatewayReply:
+        """POST an already-encoded wire frame (the open-loop driver
+        encodes at SUBMISSION time, so a shared repeat-tenant request
+        object's per-submission fields are captured correctly)."""
+        host, _, port = self.address.rpartition(":")
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=self.timeout_s
+        )
+        try:
+            conn.request(
+                "POST", "/v1/serve", body=body,
+                headers={"Content-Type": WIRE_CONTENT_TYPE},
+            )
+            resp = conn.getresponse()
+            status, payload = resp.status, resp.read()
+        finally:
+            conn.close()
+        if status == 200:
+            return GatewayReply(
+                status=status, result=decode_result(payload)
+            )
+        try:
+            error = json.loads(payload)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            error = {"error": "opaque", "body": payload[:200].decode(
+                "utf-8", "replace"
+            )}
+        return GatewayReply(status=status, error=error)

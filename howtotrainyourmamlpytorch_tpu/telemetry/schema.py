@@ -122,6 +122,22 @@ Record kinds:
   ``roofline`` summary (bound, predicted HFU/MFU, flops/task), so
   ``cli inspect summary`` can say where the MFU number goes without the
   run's stdout;
+* ``gateway``        — the networked fleet front tier (serving/gateway.py,
+  schema v13): ``event`` names the record shape — ``shed`` (one request
+  rejected at the edge before it could collapse a host queue: the typed
+  ``reason`` ('admission' — the home host's depth+in-flight estimate
+  exceeded its priority-tier budget — or 'deadline' — the request's
+  remaining ``slack_ms`` could not cover the home host's current queue
+  estimate), the ``tenant_id`` / ``priority`` / ``deadline_ms`` of the
+  rejected request and its ``host`` home assignment), ``rehome`` (a host
+  left the serving ring: the tripped ``host``, the chained root
+  ``cause``, and ``in_flight`` — how many stranded socket requests were
+  failed immediately with that cause instead of hanging), and ``rollup``
+  (the fleet condensed: ``hosts`` / ``healthy_hosts``, admitted /
+  shed-by-reason counts, and the EXACT bucket-wise merge of every
+  host's ``adapt_ms_hist`` / ``queue_ms_hist`` log histograms — fleet
+  p99 from one histogram family, never averaged percentiles). The
+  ``fleet:`` line of ``cli inspect summary`` renders these jax-free;
 * ``span``           — one causal-tracing interval (telemetry/tracing.py,
   schema v10): ``name`` (queue / assemble / dispatch / sync / request
   for serving, train_dispatch / eval_chunk / epoch_summary /
@@ -238,6 +254,21 @@ Version history / migration notes:
   (``tests/fixtures/telemetry_v11_schema.jsonl`` pins a v11-era log)
   and the forward-compat rules carry over (the future-schema fixture
   is re-pinned at v13-unknown).
+* **v13** — the networked fleet front tier (serving/gateway.py /
+  fleet.py): adds the ``gateway`` record kind (``event`` = ``shed`` —
+  one typed edge rejection with its admission/deadline ``reason`` —
+  ``rehome`` — a host tripped out of the consistent-hash ring with its
+  chained root ``cause`` and the stranded ``in_flight`` count — or
+  ``rollup`` — the fleet aggregate with exact bucket-wise histogram
+  merges), and the ``serving`` ``event='deadline'`` record gains the
+  optional gateway-path fields ``priority`` (the request's admission
+  tier) and ``gateway_ms`` (edge time: decode + admission + forward
+  before the home host enqueued it). Pure addition beyond the new kind
+  (``gateway`` requires only ``event``; ``serving`` still requires only
+  ``event``): every v1..v12 record validates unchanged
+  (``tests/fixtures/telemetry_v12_schema.jsonl`` pins a v12-era log)
+  and the forward-compat rules carry over (the future-schema fixture
+  is re-pinned at v14-unknown).
 """
 
 from __future__ import annotations
@@ -245,7 +276,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 
@@ -271,6 +302,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "analysis": ("programs", "violations"),
     "elastic": ("event",),
     "serving": ("event",),
+    "gateway": ("event",),
     "slo": ("target_ms", "requests", "missed"),
     "span": ("name", "cat", "trace_id", "span_id", "start_ms", "dur_ms"),
 }

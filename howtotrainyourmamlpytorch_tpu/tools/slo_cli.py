@@ -12,6 +12,8 @@ stream:
    python -m howtotrainyourmamlpytorch_tpu.cli slo LOG
    python -m howtotrainyourmamlpytorch_tpu.cli slo LOG --json
    python -m howtotrainyourmamlpytorch_tpu.cli slo LOG --target-ms 50
+   python -m howtotrainyourmamlpytorch_tpu.cli slo --fleet GATEWAY_LOG
+   python -m howtotrainyourmamlpytorch_tpu.cli slo --fleet LOG LOG ...
 
 The report: request/miss totals and miss rate, the error budget implied
 by the availability objective, burn rate per window (how many budgets
@@ -29,6 +31,17 @@ A log with no deadline data reports that plainly and exits 0 (pre-v12
 logs are data-free, never a crash). Exit codes: 0 ok, 1 replay/pinned
 mismatch, 2 unreadable log or unusable flags.
 
+``--fleet`` reports over a serve-bench ``--fleet`` run: the per-HOST
+telemetry logs (``root.hostNN.ext``, one per fleet-host process) are
+merged into ONE record stream, sorted by timestamp, and replayed
+through a single ``SLOTracker`` — the fleet-wide SLO is a property of
+the merged stream, not an average of per-host reports. Given a single
+path, sibling ``.hostNN.`` logs are auto-discovered next to it (so the
+gateway's own log path is enough); given several paths they are merged
+as-is. The per-replica breakdown becomes a per-HOST one (replica ids
+are host-local and would collide across hosts), and the pinned-record
+cross-check is skipped — host logs pin no fleet-wide summary.
+
 Pure stdlib + ``telemetry.schema`` + ``serving.metrics`` (both jax-free)
 — dispatched by the training CLI before anything jax-heavy loads.
 """
@@ -36,9 +49,12 @@ Pure stdlib + ``telemetry.schema`` + ``serving.metrics`` (both jax-free)
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
 import sys
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..serving.metrics import SLOTracker
 from ..telemetry.schema import iter_records
@@ -49,6 +65,62 @@ def _deadline_records(records: List[dict]) -> List[dict]:
         r for r in records
         if r.get("kind") == "serving" and r.get("event") == "deadline"
     ]
+
+
+def _host_label(path: str) -> str:
+    """A host label for a fleet shard: the ``.hostNN.`` filename segment
+    serve-bench's ``_host_log_path`` writes, else the bare stem."""
+    base = os.path.basename(path)
+    m = re.search(r"\.(host[^.]+)\.", base)
+    if m:
+        return m.group(1)
+    return os.path.splitext(base)[0]
+
+
+def _expand_fleet_logs(paths: List[str]) -> List[str]:
+    """Given one path, auto-discover its ``root.host*.ext`` siblings
+    (the serve-bench ``--fleet`` layout); given several, keep them."""
+    if len(paths) != 1:
+        return list(paths)
+    root, ext = os.path.splitext(paths[0])
+    siblings = sorted(glob.glob(glob.escape(root) + ".host*" + ext))
+    out = list(paths) if os.path.exists(paths[0]) else []
+    out.extend(p for p in siblings if p not in out)
+    return out or list(paths)
+
+
+def _merge_fleet_records(
+    per_log: List[Tuple[str, List[dict]]],
+) -> Tuple[List[dict], Dict[str, Dict[str, int]]]:
+    """Merge per-host record lists into one ts-sorted stream for a
+    single-tracker replay, plus a per-host requests/missed table.
+
+    Deadline records are shallow-copied with ``replica_id`` dropped:
+    replica ids are host-local (every host numbers its replicas from
+    0), so the tracker's per-replica series would silently merge
+    replica 0 of every host. The per-HOST breakdown is computed here
+    instead, keyed by the log's host label.
+    """
+    merged: List[dict] = []
+    per_host: Dict[str, Dict[str, int]] = {}
+    for label, records in per_log:
+        for r in records:
+            if r.get("kind") == "serving" and r.get("event") == "deadline":
+                row = per_host.setdefault(
+                    label, {"requests": 0, "missed": 0}
+                )
+                row["requests"] += 1
+                if r.get("missed"):
+                    row["missed"] += 1
+                r = {k: v for k, v in r.items() if k != "replica_id"}
+            merged.append(r)
+    merged.sort(
+        key=lambda r: r["ts"]
+        if isinstance(r.get("ts"), (int, float))
+        and not isinstance(r.get("ts"), bool)
+        else float("-inf")
+    )
+    return merged, per_host
 
 
 def _pinned_slo(records: List[dict]) -> Optional[dict]:
@@ -87,7 +159,9 @@ def _replay(records: List[dict], target_ms: float, availability: float,
 
 
 def _render(log: str, summary: Dict[str, Any],
-            mismatch: Optional[str]) -> List[str]:
+            mismatch: Optional[str],
+            per_host: Optional[Dict[str, Dict[str, int]]] = None
+            ) -> List[str]:
     lines = [f"{log}: SLO report"]
     lines.append(
         f"  objective: p(on-time) >= {summary['availability']:g} at "
@@ -120,11 +194,20 @@ def _render(log: str, summary: Dict[str, Any],
                 "worst_burn_rate"
             ] > 1.0 else ")"
         lines.append(line)
-    for label, row in sorted((summary.get("per_replica") or {}).items()):
-        lines.append(
-            f"    replica {label}: {row['requests']} request(s), "
-            f"{row['missed']} missed"
-        )
+    if per_host is not None:
+        for label, row in sorted(per_host.items()):
+            lines.append(
+                f"    host {label}: {row['requests']} request(s), "
+                f"{row['missed']} missed"
+            )
+    else:
+        for label, row in sorted(
+            (summary.get("per_replica") or {}).items()
+        ):
+            lines.append(
+                f"    replica {label}: {row['requests']} request(s), "
+                f"{row['missed']} missed"
+            )
     if mismatch:
         lines.append(f"  MISMATCH: {mismatch}")
     return lines
@@ -137,7 +220,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "log's deadline records (error budget, multi-window "
                     "burn rates, per-replica misses)",
     )
-    parser.add_argument("log", help="telemetry JSONL path")
+    parser.add_argument("log", nargs="+",
+                        help="telemetry JSONL path (with --fleet: the "
+                             "gateway log — sibling .hostNN. logs are "
+                             "auto-discovered — or several host logs)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="fleet mode: merge per-host logs into one "
+                             "ts-sorted stream, replay through a single "
+                             "tracker, report per HOST")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable JSON output")
     parser.add_argument("--target-ms", type=float, default=None,
@@ -154,24 +244,47 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "else 60/300/3600)")
     args = parser.parse_args(argv)
 
-    try:
-        records = list(iter_records(args.log))
-    except (OSError, ValueError) as e:
-        print(f"error: {e}", file=sys.stderr)
+    if not args.fleet and len(args.log) > 1:
+        print("error: several logs need --fleet (a single-run report "
+              "over many logs would be meaningless)", file=sys.stderr)
         return 2
 
+    logs = _expand_fleet_logs(args.log) if args.fleet else args.log
+    per_host: Optional[Dict[str, Dict[str, int]]] = None
+    if args.fleet:
+        per_log: List[Tuple[str, List[dict]]] = []
+        try:
+            for path in logs:
+                per_log.append((_host_label(path),
+                                list(iter_records(path))))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        records, per_host = _merge_fleet_records(per_log)
+        label = f"fleet[{len(logs)} log(s)]"
+    else:
+        try:
+            records = list(iter_records(logs[0]))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        label = logs[0]
+
     deadlines = _deadline_records(records)
-    pinned = _pinned_slo(records)
+    # host logs pin no fleet-wide summary, and a per-host slo record
+    # (if one ever appears) must not be cross-checked against the
+    # merged fleet replay — fleet mode skips the pin entirely
+    pinned = None if args.fleet else _pinned_slo(records)
     if not deadlines and pinned is None:
         # a pre-v12 log, or a run without deadline accounting: there is
         # nothing to report, which is an answer, not an error
         msg = (
-            f"{args.log}: no deadline records and no slo record — "
+            f"{label}: no deadline records and no slo record — "
             "deadline accounting was not armed (run serve-bench with "
             "--deadline-ms or serving_slo_target_ms > 0)"
         )
         if args.json:
-            print(json.dumps({"log": args.log, "slo": None,
+            print(json.dumps({"log": logs, "slo": None,
                               "note": msg}))
         else:
             print(msg)
@@ -226,16 +339,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 break
 
     if args.json:
-        print(json.dumps({
-            "log": args.log,
+        payload = {
+            "log": logs if args.fleet else logs[0],
             "slo": summary,
             "pinned": {
                 k: pinned.get(k) for k in ("requests", "missed")
             } if pinned is not None else None,
             "mismatch": mismatch,
-        }, sort_keys=True))
+        }
+        if per_host is not None:
+            payload["per_host"] = per_host
+        print(json.dumps(payload, sort_keys=True))
     else:
-        print("\n".join(_render(args.log, summary, mismatch)))
+        print("\n".join(_render(label, summary, mismatch, per_host)))
     return 1 if mismatch else 0
 
 

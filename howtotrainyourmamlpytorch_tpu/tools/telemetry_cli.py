@@ -23,9 +23,11 @@ here before importing anything jax-heavy)
   percentiles, loader stream-stall stats, HBM usage,
   anomaly/incident/stall/retry/preemption/retrace counts, the
   elastic drain/resume line (schema v6: drain protocol progress plus the
-  last old->new process-count resume with its episode cursor), and the
+  last old->new process-count resume with its episode cursor), the
   serving SLO line (schema v12: deadline-miss rate, worst burn-rate
-  window, per-replica misses — absent, never a crash, on older logs);
+  window, per-replica misses), and the fleet line (schema v13: gateway
+  host membership, admitted/shed totals, re-home events — absent,
+  never a crash, on older logs);
 * ``epochs``    — the per-epoch scalar table (loss/accuracy/step-time
   columns), the epoch CSV's queryable twin;
 * ``anomalies`` — every ``anomaly`` / ``incident`` / ``watchdog_stall`` /
@@ -389,6 +391,55 @@ def _slo_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
     }
 
 
+def _fleet_summary(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Condense the ``gateway`` records (schema v13, serving/gateway.py):
+    host membership and admitted/shed totals from the LAST fleet rollup
+    record, shed counts by reason recounted from the per-request ``shed``
+    records, and every ``rehome`` event (which host tripped, why, how
+    many in-flight requests it stranded). Returns None when the log has
+    no gateway records at all — every pre-v13 log — so the line simply
+    doesn't render; malformed fields are skipped, never a crash."""
+    gw = [r for r in records if r.get("kind") == "gateway"]
+    if not gw:
+        return None
+
+    def _count(v: Any) -> int:
+        return v if isinstance(v, int) and not isinstance(v, bool) else 0
+
+    rollup = next(
+        (r for r in reversed(gw) if r.get("event") == "rollup"), None
+    )
+    shed_by_reason: Dict[str, int] = {}
+    for r in gw:
+        if r.get("event") == "shed":
+            reason = str(r.get("reason", "?"))
+            shed_by_reason[reason] = shed_by_reason.get(reason, 0) + 1
+    # the rollup's shed counters are authoritative (per-request shed
+    # records may be absent at low telemetry levels); recounted records
+    # fill in when no rollup landed (gateway killed mid-run)
+    pinned_shed = (rollup or {}).get("shed")
+    if isinstance(pinned_shed, dict):
+        shed = {str(k): _count(v) for k, v in pinned_shed.items()}
+    else:
+        shed = shed_by_reason
+    rehomes = [r for r in gw if r.get("event") == "rehome"]
+    tripped = (rollup or {}).get("tripped_hosts")
+    return {
+        "hosts": (rollup or {}).get("hosts"),
+        "ready_hosts": (rollup or {}).get("ready_hosts"),
+        "tripped_hosts": tripped if isinstance(tripped, list) else [],
+        "admitted": (rollup or {}).get("admitted"),
+        "shed": shed,
+        "shed_total": sum(shed.values()),
+        "rehomes": len(rehomes) or _count((rollup or {}).get("rehomes")),
+        "rehomed_hosts": [
+            {k: r.get(k) for k in ("host", "cause", "in_flight")}
+            for r in rehomes
+        ],
+        "adapt_ms_p99": (rollup or {}).get("adapt_ms_p99"),
+    }
+
+
 def _dispatch_stats(records: List[dict]) -> Optional[Dict[str, float]]:
     """Step-time stats averaged over the run's ``dispatch`` records (the
     per-epoch StepTimer summaries: mean/p50/p95/p99 dispatch latency)."""
@@ -527,6 +578,9 @@ def cmd_summary(args) -> int:
         # from the per-request deadline records + the end-of-run slo
         # record's burn-rate verdict
         "slo": _slo_summary(records),
+        # fleet gateway (schema v13): host membership, admitted/shed
+        # totals, re-home events — absent, never a crash, on older logs
+        "fleet": _fleet_summary(records),
         "clean_shutdown": counts.get("run_end", 0) > 0,
     }
     lines = [
@@ -709,6 +763,38 @@ def cmd_summary(args) -> int:
             lines.append(
                 f"    slo[replica {label}]: {row['requests']} "
                 f"deadline(s), {row['missed']} missed"
+            )
+    fl = payload["fleet"]
+    if fl:
+        parts = []
+        if fl.get("hosts") is not None:
+            part = f"{fl['hosts']} host(s)"
+            if fl.get("ready_hosts") is not None:
+                part += f" ({fl['ready_hosts']} ready)"
+            parts.append(part)
+        if fl.get("admitted") is not None:
+            parts.append(f"{fl['admitted']} admitted")
+        shed_parts = ", ".join(
+            f"{n} {reason}" for reason, n in sorted(fl["shed"].items())
+            if n
+        )
+        parts.append(
+            f"{fl['shed_total']} shed"
+            + (f" ({shed_parts})" if shed_parts else "")
+        )
+        parts.append(f"{fl['rehomes']} re-home(s)")
+        if isinstance(fl.get("adapt_ms_p99"), (int, float)):
+            parts.append(f"adapt p99 {fl['adapt_ms_p99']:.2f}ms")
+        lines.append("  fleet: " + ", ".join(parts))
+        if fl["tripped_hosts"]:
+            lines.append(
+                "    fleet[tripped]: "
+                + ", ".join(str(h) for h in fl["tripped_hosts"])
+            )
+        for row in fl["rehomed_hosts"]:
+            lines.append(
+                f"    fleet[rehome]: {row.get('host')} "
+                f"({row.get('in_flight')} in flight): {row.get('cause')}"
             )
     audit = payload["audit"]
     if audit:

@@ -1,0 +1,727 @@
+"""Fleet gateway tests (serving/gateway.py + serving/fleet.py).
+
+The front tier is stdlib + numpy by design, so everything here runs
+real HTTP over loopback sockets against ``FleetHost`` instances backed
+by STUB routers/pools — the full wire path (encode -> gateway ->
+admission -> forward -> host decode -> re-stamp -> frame -> merge)
+without an engine in sight. The jax-heavy end-to-end shape lives in the
+CI ``fleet-smoke`` job (serve-bench ``--fleet``), not here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.serving import gateway as gw
+from howtotrainyourmamlpytorch_tpu.serving.batcher import (
+    AdaptRequest,
+    IndexRequest,
+)
+from howtotrainyourmamlpytorch_tpu.serving.fleet import FleetHost
+from howtotrainyourmamlpytorch_tpu.serving.metrics import LogHistogram
+from howtotrainyourmamlpytorch_tpu.serving.router import (
+    request_fingerprint,
+)
+
+
+# -- stubs -------------------------------------------------------------------
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+class _FakeResult:
+    def __init__(self, tenant_id="t0", way=3, targets=2):
+        self.tenant_id = tenant_id
+        self.preds = np.arange(
+            way * targets * 5, dtype=np.float32
+        ).reshape(way * targets, 5)
+        self.loss = 0.25
+        self.accuracy = 0.875
+
+
+class _StubPending:
+    def __init__(self, result):
+        self._result = result
+
+    def get(self, timeout=None):
+        if isinstance(self._result, BaseException):
+            raise self._result
+        return self._result
+
+
+class _StubRouter:
+    """Captures submissions; returns a canned result per request."""
+
+    def __init__(self):
+        self.submitted = []
+
+    def submit(self, request):
+        self.submitted.append(request)
+        return _StubPending(_FakeResult(request.tenant_id or "t0"))
+
+    def stats(self):
+        return {"submitted": len(self.submitted)}
+
+
+class _StubReplica:
+    def __init__(self, depth=0):
+        self._depth = depth
+
+    def queue_depth(self):
+        return self._depth
+
+
+class _StubPool:
+    def __init__(self, depth=0, hist_values=()):
+        self.replicas = [_StubReplica(depth)]
+        self._hist_values = hist_values
+
+    def readiness(self):
+        return {0: True}
+
+    def rollup(self):
+        adapt = LogHistogram()
+        queue = LogHistogram()
+        for v in self._hist_values:
+            adapt.observe(v)
+            queue.observe(v * 2.0)
+        return {
+            "dispatches": len(self._hist_values),
+            "tenants": len(self._hist_values),
+            "adapt_ms_hist": adapt.to_dict(),
+            "queue_ms_hist": queue.to_dict(),
+        }
+
+
+def _gw_cfg(**kw):
+    kw.setdefault("serving_gateway_health_interval_s", 0.05)
+    return MAMLConfig(**kw)
+
+
+def _adapt_request(seed=123, dtype=np.float32, **kw):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(3, 1, 10, 10, 1)
+    q = rng.randn(3, 2, 10, 10, 1)
+    if dtype == np.uint8:
+        x = (x * 32 + 128).clip(0, 255)
+        q = (q * 32 + 128).clip(0, 255)
+    return AdaptRequest(
+        support_x=x.astype(dtype),
+        support_y=np.tile(np.arange(3, dtype=np.int32)[:, None], (1, 1)),
+        query_x=q.astype(dtype),
+        query_y=None,
+        **kw,
+    )
+
+
+def _make_fleet(n=2, depth=0, sink=None, **cfg_kw):
+    """n stub-backed FleetHosts behind one Gateway (manual polling)."""
+    hosts, routers = {}, {}
+    members = {}
+    for i in range(n):
+        router = _StubRouter()
+        host = FleetHost(
+            router, _StubPool(depth=depth), host_id=f"host{i:02d}"
+        )
+        hosts[host.host_id] = host
+        routers[host.host_id] = router
+        members[host.host_id] = f"127.0.0.1:{host.port}"
+    gateway = gw.Gateway(
+        _gw_cfg(**cfg_kw), members, sink=sink, start_health_loop=False
+    )
+    gateway.poll_once()
+    return gateway, hosts, routers
+
+
+def _close_fleet(gateway, hosts):
+    gateway.close()
+    for h in hosts.values():
+        h.close()
+
+
+# -- wire codec --------------------------------------------------------------
+
+
+def test_wire_adapt_round_trip_preserves_arrays_and_header():
+    req = _adapt_request(tenant_id="tenant-7", deadline_ms=50.0)
+    req.priority = 1
+    frame = gw.encode_request(req)
+    back, header = gw.decode_request(frame)
+    assert isinstance(back, AdaptRequest)
+    assert header["kind"] == "adapt" and header["priority"] == 1
+    assert back.tenant_id == "tenant-7" and back.deadline_ms == 50.0
+    np.testing.assert_array_equal(back.support_x, req.support_x)
+    np.testing.assert_array_equal(back.support_y, req.support_y)
+    np.testing.assert_array_equal(back.query_x, req.query_x)
+    assert back.query_y is None
+    # the decoded arrays are writable copies, not views pinning the body
+    back.support_x[0, 0, 0, 0, 0] = 42.0
+
+
+def test_wire_inherits_ingest_compression():
+    """The PR-13 ingest encodings apply ON THE WIRE: a uint8 frame is
+    ~4x smaller than its f32 twin, and an index request against a
+    fleet-resident store is under 1KB."""
+    f32 = len(gw.encode_request(_adapt_request(dtype=np.float32)))
+    u8 = len(gw.encode_request(_adapt_request(dtype=np.uint8)))
+    assert u8 * 3 < f32
+    idx = IndexRequest(
+        support_idx=np.arange(3, dtype=np.int64)[:, None],
+        query_idx=np.arange(6, dtype=np.int64).reshape(3, 2),
+        tenant_id="tenant-9",
+    )
+    frame = gw.encode_request(idx)
+    assert len(frame) < 1024
+    back, header = gw.decode_request(frame)
+    assert isinstance(back, IndexRequest) and header["kind"] == "index"
+    assert back.labeled is True
+    assert back.support_idx.dtype == np.int32  # wire narrows to int32
+    np.testing.assert_array_equal(
+        back.support_idx, idx.support_idx.astype(np.int32)
+    )
+
+
+def test_wire_fingerprint_survives_the_codec():
+    """Routing identity can't drift across the network: the decoded
+    request hashes to the SAME affinity fingerprint the client's
+    original did (same digest recipe end to end)."""
+    for req in (
+        _adapt_request(),
+        _adapt_request(dtype=np.uint8),
+        IndexRequest(
+            support_idx=np.arange(3, dtype=np.int32)[:, None],
+            query_idx=np.arange(6, dtype=np.int32).reshape(3, 2),
+        ),
+    ):
+        back, _ = gw.decode_request(gw.encode_request(req))
+        assert request_fingerprint(back) == request_fingerprint(req)
+
+
+def test_wire_result_round_trip():
+    result = _FakeResult("tenant-3")
+    frame = gw.encode_result(result, host_id="host01", host_ms=4.25)
+    out = gw.decode_result(frame)
+    assert out["ok"] is True and out["tenant_id"] == "tenant-3"
+    assert out["loss"] == 0.25 and out["accuracy"] == 0.875
+    assert out["host_id"] == "host01" and out["host_ms"] == 4.25
+    np.testing.assert_array_equal(out["preds"], result.preds)
+
+
+def test_wire_malformed_frames_raise_typed_errors():
+    frame = gw.encode_request(_adapt_request())
+    with pytest.raises(gw.WireError, match="truncated"):
+        gw.decode_request(frame[:2])
+    with pytest.raises(gw.WireError, match="truncated"):
+        gw.decode_request(frame[:-10])  # short buffers
+    with pytest.raises(gw.WireError, match="not valid JSON"):
+        gw.decode_request(b"\x00\x00\x00\x04aaaa")
+    bad_kind = gw._encode_frame({"kind": "mystery", "arrays": []}, [])
+    with pytest.raises(gw.WireError, match="adapt.*index"):
+        gw.decode_request(bad_kind)
+
+
+# -- the consistent-hash host ring -------------------------------------------
+
+
+def test_home_host_is_sorted_ring_modular_arithmetic():
+    fp = request_fingerprint(_adapt_request())
+    hosts = ["host02", "host00", "host01"]
+    assert gw.home_host(fp, hosts) == gw.home_host(fp, sorted(hosts))
+    assert gw.home_host(fp, hosts) in hosts
+    # a single-host fleet is degenerate but legal
+    assert gw.home_host(fp, ["only"]) == "only"
+
+
+def test_home_host_stable_across_process_restarts():
+    """The fleet-level twin of the router's fingerprint-stability test:
+    (fingerprint -> home host) is a pure function of the content digest
+    and the membership set — two fresh interpreters with different
+    PYTHONHASHSEEDs must agree with this process bit-for-bit (the
+    adapted-params cache key survives a gateway restart)."""
+    script = (
+        "import numpy as np\n"
+        "from howtotrainyourmamlpytorch_tpu.serving.gateway import (\n"
+        "    home_host)\n"
+        "from howtotrainyourmamlpytorch_tpu.serving.router import (\n"
+        "    request_fingerprint)\n"
+        "from howtotrainyourmamlpytorch_tpu.serving.batcher import (\n"
+        "    AdaptRequest, IndexRequest)\n"
+        "rng = np.random.RandomState(123)\n"
+        "req = AdaptRequest(\n"
+        "    support_x=rng.randn(3, 1, 10, 10, 1).astype(np.float32),\n"
+        "    support_y=np.tile(\n"
+        "        np.arange(3, dtype=np.int32)[:, None], (1, 1)),\n"
+        "    query_x=rng.randn(3, 2, 10, 10, 1).astype(np.float32),\n"
+        "    query_y=None)\n"
+        "idx = IndexRequest(\n"
+        "    support_idx=np.arange(3, dtype=np.int64)[:, None],\n"
+        "    query_idx=np.arange(6, dtype=np.int64).reshape(3, 2))\n"
+        "ring = ['host02', 'host00', 'host03', 'host01']\n"
+        "for r in (req, idx):\n"
+        "    fp = request_fingerprint(r)\n"
+        "    print(fp, home_host(fp, ring))\n"
+    )
+    outs = []
+    for seed in ("0", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        outs.append(subprocess.run(
+            [sys.executable, "-c", script], env=env, text=True,
+            capture_output=True, check=True, timeout=120,
+        ).stdout)
+    assert outs[0] == outs[1]
+    # ... and with THIS process (a third interpreter lifetime)
+    ring = ["host02", "host00", "host03", "host01"]
+    rng = np.random.RandomState(123)
+    req = AdaptRequest(
+        support_x=rng.randn(3, 1, 10, 10, 1).astype(np.float32),
+        support_y=np.tile(np.arange(3, dtype=np.int32)[:, None], (1, 1)),
+        query_x=rng.randn(3, 2, 10, 10, 1).astype(np.float32),
+        query_y=None,
+    )
+    idx = IndexRequest(
+        support_idx=np.arange(3, dtype=np.int64)[:, None],
+        query_idx=np.arange(6, dtype=np.int64).reshape(3, 2),
+    )
+    lines = [
+        f"{request_fingerprint(r)} "
+        f"{gw.home_host(request_fingerprint(r), ring)}"
+        for r in (req, idx)
+    ]
+    assert outs[0] == "\n".join(lines) + "\n"
+
+
+# -- end to end over real sockets --------------------------------------------
+
+
+def test_gateway_serves_end_to_end_and_stamps_edge_fields():
+    """Client frame -> gateway -> host -> framed result: the reply
+    decodes, the host saw the gateway-stamped fields (clamped priority,
+    remaining deadline, wire-elapsed gateway_ms), and the admission
+    counters moved."""
+    gateway, hosts, routers = _make_fleet(n=2)
+    server = gw.GatewayServer(gateway)
+    try:
+        client = gw.GatewayClient(f"127.0.0.1:{server.port}")
+        req = _adapt_request(tenant_id="tenant-1", deadline_ms=500.0)
+        req.priority = 99  # clamped into the tier range at the edge
+        reply = client.serve(req)
+        assert reply.ok and reply.status == 200
+        assert reply.result["tenant_id"] == "tenant-1"
+        assert reply.result["host_id"] in hosts
+        np.testing.assert_array_equal(
+            reply.result["preds"], _FakeResult("tenant-1").preds
+        )
+        seen = [r for rt in routers.values() for r in rt.submitted]
+        assert len(seen) == 1
+        assert seen[0].priority == gateway.priority_tiers - 1
+        assert seen[0].gateway_ms is not None
+        # only DURATIONS cross the wire: the host-side deadline is the
+        # REMAINING budget, strictly under the client's original
+        assert 0 < seen[0].deadline_ms < 500.0
+        assert (
+            pytest.approx(500.0 - seen[0].gateway_ms)
+            == seen[0].deadline_ms
+        )
+        assert gateway.admitted == 1
+        # affinity: the home host actually served it
+        fp = request_fingerprint(req)
+        assert reply.result["host_id"] == gw.home_host(fp, list(hosts))
+    finally:
+        server.close()
+        _close_fleet(gateway, hosts)
+
+
+def test_gateway_bad_frame_is_typed_400():
+    gateway, hosts, _ = _make_fleet(n=1)
+    try:
+        status, ctype, body = gateway.handle_serve(b"garbage")
+        assert status == 400 and ctype == "application/json"
+        assert json.loads(body)["error"] == "bad_request"
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+# -- admission control + deadline shedding -----------------------------------
+
+
+def test_admission_shed_is_typed_and_recorded():
+    """A request whose home host's load estimate is at the budget is
+    rejected AT THE EDGE: HTTP 429, reason='admission', one gateway
+    shed record, nothing forwarded."""
+    sink = _ListSink()
+    gateway, hosts, routers = _make_fleet(
+        n=1, depth=4, sink=sink, serving_gateway_queue_budget=4
+    )
+    try:
+        status, _, body = gateway.handle_serve(
+            gw.encode_request(_adapt_request(tenant_id="t-shed"))
+        )
+        assert status == 429
+        err = json.loads(body)
+        assert err["error"] == "shed" and err["reason"] == "admission"
+        assert err["load"] == 4 and err["budget"] == 4
+        assert gateway.shed["admission"] == 1 and gateway.admitted == 0
+        assert not any(rt.submitted for rt in routers.values())
+        recs = [r for r in sink.records if r.get("event") == "shed"]
+        assert len(recs) == 1 and recs[0]["kind"] == "gateway"
+        assert recs[0]["reason"] == "admission"
+        assert recs[0]["tenant_id"] == "t-shed"
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_priority_tiers_shrink_the_admission_budget():
+    """Tier 0 keeps the full budget; tier k gets budget >> k — the same
+    load admits a tier-0 request and sheds a tier-2 one."""
+    gateway, hosts, routers = _make_fleet(
+        n=1, depth=5, serving_gateway_queue_budget=8,
+        serving_gateway_priority_tiers=3,
+    )
+    try:
+        lo = _adapt_request(tenant_id="t-lo")
+        status, _, _ = gateway.handle_serve(gw.encode_request(lo))
+        assert status == 200  # load 5 < budget 8
+        hi = _adapt_request(tenant_id="t-hi")
+        hi.priority = 2
+        status, _, body = gateway.handle_serve(gw.encode_request(hi))
+        assert status == 429  # load 5 >= 8 >> 2 == 2
+        assert json.loads(body)["budget"] == 2
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_deadline_shed_against_queue_estimate():
+    """A deadline the home host's queue estimate (load x service-time
+    EWMA) already exceeds is shed typed instead of queued to die."""
+    sink = _ListSink()
+    gateway, hosts, _ = _make_fleet(
+        n=1, sink=sink, serving_gateway_queue_budget=1024
+    )
+    try:
+        # establish the EWMA with one served request...
+        status, _, _ = gateway.handle_serve(
+            gw.encode_request(_adapt_request(tenant_id="t-warm"))
+        )
+        assert status == 200
+        h = gateway.ring[0]
+        assert h.ewma_ms is not None and h.ewma_ms > 0
+        # ...then pile up a queue and ask for the impossible
+        hosts[h.host_id].pool.replicas[0]._depth = 500
+        gateway.poll_once()
+        doomed = _adapt_request(tenant_id="t-doomed", deadline_ms=0.001)
+        status, _, body = gateway.handle_serve(gw.encode_request(doomed))
+        assert status == 429
+        err = json.loads(body)
+        assert err["reason"] == "deadline"
+        assert err["queue_est_ms"] >= 0.001
+        assert gateway.shed == {"admission": 0, "deadline": 1}
+        recs = [r for r in sink.records if r.get("event") == "shed"]
+        assert recs and recs[-1]["reason"] == "deadline"
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_host_sheds_when_edge_spent_the_whole_budget():
+    """The host-side backstop: a frame arriving with its deadline
+    budget already consumed by the edge (gateway_elapsed_ms >=
+    deadline_ms — the shed estimate raced a slow forward) is refused
+    429 at the HOST, never queued."""
+    router = _StubRouter()
+    host = FleetHost(router, _StubPool())
+    try:
+        frame = gw.encode_request(
+            _adapt_request(tenant_id="t-late", deadline_ms=10.0)
+        )
+        header, blob = gw._decode_frame(frame)
+        header["gateway_elapsed_ms"] = 50.0
+        status, _, body = host.handle_serve(
+            gw._encode_frame(header, [blob])
+        )
+        assert status == 429
+        err = json.loads(body)
+        assert err["reason"] == "deadline" and err["where"] == "host"
+        assert not router.submitted
+    finally:
+        host.close()
+
+
+# -- host loss: trip, re-home, fail fast -------------------------------------
+
+
+def test_host_death_between_sweeps_rehomes_in_flight_request():
+    """The satellite-2 regression: a host dying BETWEEN health sweeps
+    is caught at forward time — the socket failure trips it (one
+    rehome record, root cause chained) and the SAME request is retried
+    on its deterministic re-home, so zero admitted requests drop."""
+    sink = _ListSink()
+    gateway, hosts, routers = _make_fleet(n=3, sink=sink)
+    try:
+        req = _adapt_request(tenant_id="t-survivor", deadline_ms=800.0)
+        fp = request_fingerprint(req)
+        home = gw.home_host(fp, list(hosts))
+        # kill the home WITHOUT a health sweep noticing
+        hosts[home].close()
+        status, ctype, body = gateway.handle_serve(
+            gw.encode_request(req)
+        )
+        assert status == 200 and ctype == gw.WIRE_CONTENT_TYPE
+        served_by = gw.decode_result(body)["host_id"]
+        assert served_by != home
+        # deterministic re-home: the next ready host on the FIXED ring
+        ring_ids = [h.host_id for h in gateway.ring]
+        expect = ring_ids[
+            (ring_ids.index(home) + 1) % len(ring_ids)
+        ]
+        assert served_by == expect
+        assert gateway.rehomes == 1 and gateway.forward_failures == 1
+        dead = next(h for h in gateway.ring if h.host_id == home)
+        assert dead.tripped and dead.trip_cause is not None
+        recs = [r for r in sink.records if r.get("event") == "rehome"]
+        assert len(recs) == 1 and recs[0]["host"] == home
+        assert "ConnectionRefused" in recs[0]["cause"]
+        # healthy homes never reshuffle: a request homed on a live host
+        # still lands there after the trip
+        for _ in range(8):
+            other = _adapt_request(
+                seed=np.random.randint(1 << 30), tenant_id="t-other"
+            )
+            ofp = request_fingerprint(other)
+            if gw.home_host(ofp, list(hosts)) != home:
+                status, _, body = gateway.handle_serve(
+                    gw.encode_request(other)
+                )
+                assert status == 200
+                assert gw.decode_result(body)["host_id"] == gw.home_host(
+                    ofp, list(hosts)
+                )
+                break
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_all_hosts_down_is_immediate_typed_503_with_chained_causes():
+    """No ready host left: the request fails IMMEDIATELY (no socket
+    hang) with the typed host_down body chaining every forward
+    failure's root cause — the batcher worker-crash semantics at the
+    network layer."""
+    gateway, hosts, _ = _make_fleet(n=2)
+    try:
+        for h in hosts.values():
+            h.close()
+        status, _, body = gateway.handle_serve(
+            gw.encode_request(_adapt_request(tenant_id="t-doomed"))
+        )
+        assert status == 503
+        err = json.loads(body)
+        assert err["error"] == "host_down"
+        assert err["cause"] and "ConnectionRefused" in err["cause"]
+        assert len(err["causes"]) == 2  # both hosts' failures chained
+        assert gateway.rehomes == 2
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_poll_once_trips_only_previously_ready_hosts():
+    """The PR-15 trip gate, lifted: a host that NEVER came up is
+    skipped (still warming), not tripped; one that answered ready and
+    then vanished is latched out with exactly one rehome record."""
+    sink = _ListSink()
+    router = _StubRouter()
+    live = FleetHost(router, _StubPool(), host_id="host00")
+    members = {
+        "host00": f"127.0.0.1:{live.port}",
+        # a port nothing listens on: never ready, never tripped
+        "host01": "127.0.0.1:1",
+    }
+    gateway = gw.Gateway(
+        _gw_cfg(), members, sink=sink, start_health_loop=False
+    )
+    try:
+        gateway.poll_once()
+        h0, h1 = gateway.ring
+        assert h0.ready and not h1.ready and not h1.tripped
+        live.close()
+        gateway.poll_once()
+        gateway.poll_once()  # a second sweep must not double-trip
+        assert h0.tripped and not h1.tripped
+        assert gateway.rehomes == 1
+        assert sum(
+            1 for r in sink.records if r.get("event") == "rehome"
+        ) == 1
+    finally:
+        gateway.close()
+
+
+# -- fleet rollup: exact histogram merge -------------------------------------
+
+
+def test_fleet_rollup_merges_histograms_exactly():
+    """Fleet p99 comes from ONE merged histogram family, not averaged
+    percentiles: the gateway rollup over two hosts' rollup payloads
+    equals a single histogram that observed every value (the PR-17
+    merge contract, across process boundaries)."""
+    values_a = [1.0, 2.0, 3.0, 40.0]
+    values_b = [0.5, 2.0, 800.0]
+    router_a, router_b = _StubRouter(), _StubRouter()
+    host_a = FleetHost(
+        router_a, _StubPool(hist_values=values_a), host_id="host00"
+    )
+    host_b = FleetHost(
+        router_b, _StubPool(hist_values=values_b), host_id="host01"
+    )
+    sink = _ListSink()
+    gateway = gw.Gateway(
+        _gw_cfg(),
+        {
+            "host00": f"127.0.0.1:{host_a.port}",
+            "host01": f"127.0.0.1:{host_b.port}",
+        },
+        sink=sink, start_health_loop=False,
+    )
+    try:
+        gateway.poll_once()
+        out = gateway.rollup()
+        truth = LogHistogram()
+        for v in values_a + values_b:
+            truth.observe(v)
+        merged = LogHistogram.from_dict(out["adapt_ms_hist"])
+        assert merged.counts == truth.counts
+        assert merged.count == truth.count
+        # `sum` is rounded to 6 decimals on the wire — exact otherwise
+        assert merged.total == pytest.approx(truth.total, abs=1e-5)
+        assert merged.min == truth.min and merged.max == truth.max
+        for q in (0.5, 0.95, 0.99):
+            assert merged.quantile(q) == truth.quantile(q)
+        assert out["adapt_ms_p99"] == truth.quantile(0.99)
+        assert out["tenants"] == len(values_a) + len(values_b)
+        assert len(out["per_host"]) == 2
+        # the queue hists merged independently (2x the adapt values)
+        qtruth = LogHistogram()
+        for v in values_a + values_b:
+            qtruth.observe(v * 2.0)
+        qmerged = LogHistogram.from_dict(out["queue_ms_hist"])
+        assert qmerged.counts == qtruth.counts
+        # and the schema-v13 rollup record landed in the sink
+        recs = [r for r in sink.records if r.get("event") == "rollup"]
+        assert len(recs) == 1 and recs[0]["kind"] == "gateway"
+        assert recs[0]["hosts"] == 2 and recs[0]["ready_hosts"] == 2
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            schema as tel,
+        )
+
+        tel.validate_record(json.loads(json.dumps(recs[0])))
+    finally:
+        gateway.close()
+        host_a.close()
+        host_b.close()
+
+
+# -- offline readers over fleet logs -----------------------------------------
+
+
+def test_slo_cli_fleet_merges_host_logs(tmp_path, capsys):
+    """`cli slo --fleet GATEWAY_LOG` auto-discovers the .hostNN.
+    sibling logs, merges their deadline records into one replay, and
+    reports per HOST."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import make_record
+    from howtotrainyourmamlpytorch_tpu.tools import slo_cli
+
+    base = tmp_path / "fleet.jsonl"
+    base.write_text(json.dumps(make_record(
+        "gateway", event="rollup", hosts=2, admitted=5,
+    )) + "\n")
+    for hid, n_missed, n_ok in (("host00", 1, 2), ("host01", 0, 2)):
+        with open(tmp_path / f"fleet.{hid}.jsonl", "w") as f:
+            for i in range(n_ok):
+                f.write(json.dumps(make_record(
+                    "serving", event="deadline", tenant_id=f"t{i}",
+                    deadline_ms=50.0, slack_ms=30.0, missed=False,
+                    e2e_ms=20.0, replica_id=0,
+                )) + "\n")
+            for i in range(n_missed):
+                f.write(json.dumps(make_record(
+                    "serving", event="deadline", tenant_id=f"m{i}",
+                    deadline_ms=50.0, slack_ms=-10.0, missed=True,
+                    e2e_ms=60.0, replica_id=0,
+                )) + "\n")
+    assert slo_cli.main(["--fleet", str(base), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["slo"]["requests"] == 5
+    assert payload["slo"]["missed"] == 1
+    assert payload["per_host"]["host00"] == {
+        "requests": 3, "missed": 1,
+    }
+    assert payload["per_host"]["host01"] == {
+        "requests": 2, "missed": 0,
+    }
+    # several explicit paths work too, and the text report is per host
+    assert slo_cli.main([
+        "--fleet",
+        str(tmp_path / "fleet.host00.jsonl"),
+        str(tmp_path / "fleet.host01.jsonl"),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "host host00: 3 request(s), 1 missed" in out
+    # without --fleet, several logs are refused loudly
+    assert slo_cli.main([
+        str(tmp_path / "fleet.host00.jsonl"),
+        str(tmp_path / "fleet.host01.jsonl"),
+    ]) == 2
+
+
+def test_inspect_summary_renders_fleet_line(tmp_path, capsys):
+    """`cli inspect summary` renders the v13 fleet line (hosts, shed
+    counts, re-homes) — and pre-v13 logs render without one, never a
+    crash."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.sinks import make_record
+    from howtotrainyourmamlpytorch_tpu.tools import telemetry_cli
+
+    log = tmp_path / "gw.jsonl"
+    with open(log, "w") as f:
+        f.write(json.dumps(make_record(
+            "gateway", event="shed", reason="admission", host="host00",
+            tenant_id="t1", priority=0,
+        )) + "\n")
+        f.write(json.dumps(make_record(
+            "gateway", event="rehome", host="host02",
+            cause="ConnectionRefusedError(111, 'Connection refused')",
+            in_flight=1,
+        )) + "\n")
+        f.write(json.dumps(make_record(
+            "gateway", event="rollup", hosts=3, ready_hosts=2,
+            tripped_hosts=["host02"], admitted=40,
+            shed={"admission": 2, "deadline": 1}, rehomes=1,
+            tenants=40, dispatches=35, adapt_ms_p99=12.5,
+        )) + "\n")
+    assert telemetry_cli.main(["summary", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet: 3 host(s) (2 ready), 40 admitted" in out
+    assert "3 shed (2 admission, 1 deadline)" in out
+    assert "1 re-home(s)" in out and "adapt p99 12.50ms" in out
+    assert "fleet[tripped]: host02" in out
+    assert "fleet[rehome]: host02 (1 in flight)" in out
+    assert telemetry_cli.main(["summary", str(log), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["fleet"]["shed_total"] == 3
+    assert payload["fleet"]["rehomes"] == 1
+    # pre-v13 log: no fleet line, exit 0
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures",
+        "telemetry_v12_schema.jsonl",
+    )
+    assert telemetry_cli.main(["summary", fixture]) == 0
+    assert "fleet:" not in capsys.readouterr().out

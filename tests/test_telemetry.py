@@ -998,3 +998,82 @@ def test_v12_histogram_bearing_rollup_round_trips():
     assert back.count == hist.count and back.min == hist.min
     assert back.quantile(0.5) == hist.quantile(0.5)
     assert wire["window_dropped"] == 0
+
+
+# -- schema v13: fleet gateway (gateway kind + deadline priority fields) -----
+
+
+def test_validate_file_accepts_v12_era_fixture():
+    """The pinned v12-era log (deadline/slo records and the
+    histogram-bearing rollup shape of the PREVIOUS schema) validates
+    unchanged under v13 — pure addition, nothing tightened."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v12_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 7
+
+
+def test_v13_gateway_shed_record_round_trips():
+    """The gateway kind, event='shed': one typed edge rejection
+    (admission or deadline) with its host/tenant attribution validates,
+    JSON round-trips, and the required-field floor (event) is
+    enforced."""
+    rec = tel.make_record(
+        "gateway", event="shed", reason="admission", host="host00",
+        tenant_id="tenant-3", priority=1, queue_depth=64, budget=32,
+    )
+    assert rec["schema"] == tel.SCHEMA_VERSION and rec["kind"] == "gateway"
+    tel.validate_record(rec)
+    assert json.loads(json.dumps(rec, allow_nan=False)) == rec
+    with pytest.raises(ValueError, match="missing required fields"):
+        tel.validate_record({
+            "schema": tel.SCHEMA_VERSION, "ts": 1.0, "kind": "gateway",
+        })
+
+
+def test_v13_gateway_rehome_and_rollup_records_validate():
+    """The other two gateway events: a host trip/re-home marker (which
+    host, the chained root cause, how many in-flight requests it
+    stranded) and the fleet rollup with its exactly-merged histogram
+    payloads."""
+    from howtotrainyourmamlpytorch_tpu.serving.metrics import LogHistogram
+
+    tel.validate_record(tel.make_record(
+        "gateway", event="rehome", host="host02",
+        cause="ConnectionRefusedError(111, 'Connection refused')",
+        in_flight=2,
+    ))
+    hist = LogHistogram()
+    for v in (1.0, 2.0, 3.0):
+        hist.observe(v)
+    rec = tel.make_record(
+        "gateway", event="rollup", hosts=3, ready_hosts=2,
+        tripped_hosts=["host02"], admitted=120,
+        shed={"admission": 4, "deadline": 1}, rehomes=1,
+        tenants=120, dispatches=97, adapt_ms_p99=hist.quantile(0.99),
+        adapt_ms_hist=hist.to_dict(),
+        queue_ms_hist=LogHistogram().to_dict(),
+    )
+    tel.validate_record(rec)
+    wire = json.loads(json.dumps(rec, allow_nan=False))
+    back = LogHistogram.from_dict(wire["adapt_ms_hist"])
+    assert back.counts == hist.counts and back.count == hist.count
+
+
+def test_v13_deadline_priority_fields_ride_serving_records():
+    """The v13 deadline-record additions: the gateway-stamped priority
+    tier and on-the-wire elapsed milliseconds ride the serving
+    event='deadline' shape as optional fields — present they validate,
+    absent (every pre-v13 record) nothing is required."""
+    rec = tel.make_record(
+        "serving", event="deadline", tenant_id="t-7", shots=1,
+        deadline_ms=50.0, slack_ms=40.0, missed=False, e2e_ms=10.0,
+        queue_ms=1.0, route_ms=0.1, priority=2, gateway_ms=0.31,
+        replica_id=0,
+    )
+    tel.validate_record(rec)
+    assert rec["priority"] == 2 and rec["gateway_ms"] == 0.31
+    tel.validate_record(tel.make_record(
+        "serving", event="deadline", tenant_id="t-7", shots=1,
+        deadline_ms=50.0, slack_ms=40.0, missed=False, e2e_ms=10.0,
+    ))
