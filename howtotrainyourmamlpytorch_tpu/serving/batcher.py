@@ -70,6 +70,14 @@ class AdaptRequest:
     #: the gateway's share of the deadline record's stage attribution.
     #: None for in-process traffic.
     gateway_ms: Optional[float] = None
+    #: the gateway's trace baggage (serving/fleet.py stamps it from the
+    #: wire header when the edge is tracing): ``trace_id`` /
+    #: ``parent_span_id`` — the batcher's root span then parents under
+    #: the gateway's forward span, carrying the gateway's trace id —
+    #: plus the pass-through ``request_id`` and the edge's current
+    #: ``clock_offset_ms`` estimate for this host. None for in-process
+    #: traffic AND whenever the edge isn't tracing.
+    trace_ctx: Optional[Dict[str, Any]] = None
 
     @property
     def shots(self) -> int:
@@ -98,9 +106,11 @@ class IndexRequest:
     tenant_id: Optional[str] = None
     #: see ``AdaptRequest.deadline_ms``
     deadline_ms: Optional[float] = None
-    #: see ``AdaptRequest.priority`` / ``AdaptRequest.gateway_ms``
+    #: see ``AdaptRequest.priority`` / ``AdaptRequest.gateway_ms`` /
+    #: ``AdaptRequest.trace_ctx``
     priority: Optional[int] = None
     gateway_ms: Optional[float] = None
+    trace_ctx: Optional[Dict[str, Any]] = None
 
     @property
     def shots(self) -> int:
@@ -316,12 +326,32 @@ class MicroBatcher:
         if tracer.enabled:
             # the request's causal root: request_id ties every stage of
             # this request together across threads; closed when the
-            # future resolves (success, dispatch error, or close() sweep)
-            request_id = f"{tracer.trace_id}-r{next(self._request_ids):06d}"
+            # future resolves (success, dispatch error, or close() sweep).
+            # A gateway-minted trace (request.trace_ctx, stamped from the
+            # wire header by serving/fleet.py) is ADOPTED: the root
+            # parents under the gateway's forward span and inherits its
+            # trace id, so `cli trace --fleet` reassembles one tree
+            ctx = getattr(request, "trace_ctx", None) or {}
+            parent = None
+            root_attrs: Dict[str, Any] = {}
+            if ctx.get("trace_id") and ctx.get("parent_span_id"):
+                from ..telemetry.tracing import remote_span
+
+                parent = remote_span(
+                    str(ctx["trace_id"]), str(ctx["parent_span_id"])
+                )
+                offset = ctx.get("clock_offset_ms")
+                if offset is not None:
+                    root_attrs["clock_offset_ms"] = offset
+            request_id = (
+                ctx.get("request_id")
+                or f"{tracer.trace_id}-r{next(self._request_ids):06d}"
+            )
             pending.span = tracer.start_span(
-                "request", cat="serving", parent=None,
+                "request", cat="serving", parent=parent,
                 request_id=request_id, shots=request.shots,
                 tenant_id=getattr(request, "tenant_id", None),
+                **root_attrs,
             )
             pending.queue_span = tracer.start_span(
                 "queue", cat="serving", parent=pending.span,

@@ -313,7 +313,18 @@ class _DeviceOccupancyShim:
 
     def serve_group(self, requests, queue_ms: float = 0.0):
         out = self._engine.serve_group(requests, queue_ms=queue_ms)
+        t0 = time.perf_counter()
         time.sleep(self._hold_s)
+        tracer = getattr(self._engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            # the emulated occupancy window is device time the engine's
+            # own dispatch span can't see; without this span the fleet
+            # critical path would blame it on "wire" / lose it entirely
+            sp = tracer.start_span(
+                "device_hold", cat="serving", start_ms=t0 * 1e3,
+                emulated=True, hold_ms=round(self._hold_s * 1e3, 3),
+            )
+            tracer.end_span(sp)
         return out
 
     def __getattr__(self, name):
@@ -502,6 +513,8 @@ def _spawn_fleet_hosts(args, n_hosts: int, per_host_replicas: int,
         if args.telemetry:
             cmd += ["--telemetry",
                     _host_log_path(args.telemetry, host_id)]
+            if args.trace:
+                cmd += ["--trace"]
         procs[host_id] = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, text=True
         )
@@ -567,7 +580,21 @@ def _drive_fleet(args, cfg, shots_buckets, n_requests, deadline_ms):
         from ..telemetry.sinks import JsonlSink
 
         sink = JsonlSink(args.telemetry)
-    gateway = Gateway(cfg, members, sink=sink)
+    tracer = None
+    if args.trace and sink is not None:
+        from ..telemetry.sinks import make_record
+        from ..telemetry.tracing import Tracer
+
+        span_sink = sink
+
+        def _emit(**fields):
+            span_sink.write(make_record("span", **fields))
+
+        # the edge's tracer: process-labelled and id-prefixed so the
+        # merged fleet log (`cli trace --fleet`) keeps one track per
+        # process and span ids unique across processes
+        tracer = Tracer(emit=_emit, process="gateway", span_prefix="gw-")
+    gateway = Gateway(cfg, members, sink=sink, tracer=tracer)
     exit_code = 1
     try:
         gateway.wait_ready(timeout_s=300)
@@ -668,6 +695,7 @@ def _drive_fleet(args, cfg, shots_buckets, n_requests, deadline_ms):
                 "tenants": rollup["tenants"],
                 "dispatches": rollup["dispatches"],
                 "priority_spread": bool(args.priority_spread),
+                "traced": bool(args.trace),
             },
         }
         print(json.dumps(line))
@@ -930,10 +958,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.replicas is not None and args.replicas < 1:
             parser.error("--replicas (per-host pool width under "
                          f"--fleet) must be >= 1, got {args.replicas}")
+        # --trace is fleet-legal since the distributed-tracing PR: the
+        # gateway traces the edge, every host traces its own engine,
+        # and `cli trace --fleet` merges the per-process logs
         for flag, name in ((args.rollover, "--rollover"),
                            (args.profile_request, "--profile-request"),
                            (args.metrics_port, "--metrics-port"),
-                           (args.trace, "--trace"),
                            (args.export_dir, "--export-dir")):
             if flag:
                 parser.error(f"{name} applies to the in-process paths; "

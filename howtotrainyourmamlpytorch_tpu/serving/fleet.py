@@ -79,6 +79,12 @@ class FleetHost:
         host_self = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 so the gateway's keep-alive connection pool can
+            # actually reuse sockets (the 1.0 default closes per
+            # request); every response already carries Content-Length,
+            # which 1.1 persistence requires
+            protocol_version = "HTTP/1.1"
+
             def _send(self, status: int, ctype: str,
                       body: bytes) -> None:
                 self.send_response(status)
@@ -109,6 +115,12 @@ class FleetHost:
                         "ready": ready,
                         "host_id": host_self.host_id,
                         "queue_depth": host_self.queue_depth(),
+                        # this host's own monotonic clock, for the
+                        # gateway's Cristian offset estimator — the ONE
+                        # place a raw timestamp crosses the wire, and
+                        # only into an estimator that assumes nothing
+                        # about either origin
+                        "perf_ms": time.perf_counter() * 1e3,
                     })
                 elif self.path == "/stats":
                     self._send_json(200, host_self.stats())
@@ -170,6 +182,18 @@ class FleetHost:
         priority = header.get("priority")
         if priority is not None:
             request.priority = int(priority)
+        trace_id = header.get("trace_id")
+        parent_span_id = header.get("parent_span_id")
+        if isinstance(trace_id, str) and isinstance(parent_span_id, str):
+            # the gateway's trace baggage (only present while the edge
+            # traces): the batcher adopts it so this host's span tree
+            # parents under the gateway's forward span
+            request.trace_ctx = {
+                "trace_id": trace_id,
+                "parent_span_id": parent_span_id,
+                "request_id": header.get("request_id"),
+                "clock_offset_ms": header.get("clock_offset_ms"),
+            }
         if request.deadline_ms is not None and gateway_ms is not None:
             remaining = float(request.deadline_ms) - float(gateway_ms)
             if remaining <= 0:
@@ -259,8 +283,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="this host's telemetry JSONL (deadline/"
                              "serving records; `cli slo --fleet` merges "
                              "the per-host logs)")
+    parser.add_argument("--trace", action="store_true",
+                        help="emit span records into --telemetry "
+                             "(process-labelled, gateway-adoptable; "
+                             "`cli trace --fleet` merges them)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
+    if args.trace and not args.telemetry:
+        parser.error("--trace requires --telemetry (spans are records)")
     if args.replicas < 1:
         parser.error(f"--replicas must be >= 1, got {args.replicas}")
     if args.emulate_device_ms < 0:
@@ -300,6 +330,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         else args.cache_size
     )
     store = _synth_store(cfg) if ingest == "index" else None
+    tracer = None
+    if args.trace and sink is not None:
+        from ..telemetry.sinks import make_record
+        from ..telemetry.tracing import Tracer
+
+        span_sink = sink
+
+        def _emit(**fields):
+            span_sink.write(make_record("span", **fields))
+
+        # process-labelled + id-prefixed so the merged fleet log keeps
+        # span ids unique and `cli trace --fleet` gets its track label
+        tracer = Tracer(emit=_emit, process=args.host_id,
+                        span_prefix=f"{args.host_id}-")
     import jax
 
     pool_devices = None
@@ -310,6 +354,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg, state, n_replicas=args.replicas, devices=pool_devices,
         shots_buckets=shots_buckets, sink=sink, strict_retrace=True,
         ingest=ingest, store=store, cache_size=cache_size,
+        tracer=tracer,
     )
     pool.warmup()
     if args.emulate_device_ms:

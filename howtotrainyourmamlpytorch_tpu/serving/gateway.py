@@ -54,6 +54,26 @@ measures:
   (serving/metrics.py — the PR-17 mergeable-histogram machinery), so
   fleet p99 and burn rates come from one histogram family, never from
   averaged percentiles.
+* **Keep-alive forwarding** — each host handle keeps a small pool of
+  ``http.client`` connections; a reused keep-alive that fails
+  mid-request (the host closed it between requests — indistinguishable
+  from a death at the socket level) earns exactly ONE retry on a
+  guaranteed-fresh socket before the failure trips the host, so stale
+  pool entries never masquerade as host loss and real loss is still
+  caught on the first fresh socket. ``/stats`` reports the reuse rate.
+* **Distributed tracing** — with a tracer wired, the gateway mints one
+  trace per request (root ``request`` span backdated to edge arrival,
+  ``gateway_queue`` for decode+admission, per-attempt ``forward`` +
+  ``wire`` children — re-home retries are SIBLING forwards under the
+  same root, typed sheds zero-duration ``shed`` spans) and carries
+  ``trace_id`` / ``parent_span_id`` baggage in the forward frame's
+  header so the host-side tree parents under the gateway's forward
+  span. Tracing off is the NULL_TRACER one-attribute check and the
+  forward frames stay byte-identical to the schema-v13 wire (the trace
+  keys are simply absent). Because the processes never share a clock,
+  the health sweep doubles as a Cristian clock-offset estimator
+  (``ClockOffsetEstimator``): ``cli trace --fleet`` merges the
+  per-process logs into one clock-aligned Perfetto export.
 
 Everything here is stdlib + numpy — importable (and testable) without
 jax, like the router it extends.
@@ -62,6 +82,7 @@ jax, like the router it extends.
 from __future__ import annotations
 
 import http.client
+import itertools
 import json
 import struct
 import threading
@@ -71,6 +92,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry.tracing import NULL_TRACER, new_trace_id
 from .batcher import AdaptRequest, IndexRequest
 from .router import home_replica, request_fingerprint
 
@@ -276,6 +298,54 @@ def home_host(fingerprint: str, hosts: Sequence[str]) -> str:
 # -- gateway -----------------------------------------------------------------
 
 
+class ClockOffsetEstimator:
+    """Cristian's algorithm over the health sweep's request/response
+    timestamps.
+
+    The gateway and its hosts deliberately never compare clocks — every
+    process records spans against its OWN ``time.perf_counter`` origin.
+    To merge their span logs onto one timeline, each /healthz poll
+    contributes one sample: the gateway stamps ``t0``/``t1`` around the
+    GET, the host replies with its own perf_counter milliseconds
+    (``remote``), and under symmetric transit the host read the clock at
+    the gateway-time midpoint, so ``offset = remote - (t0 + t1) / 2``.
+    Transit is NOT symmetric, but the error is bounded: with one-way
+    delays d1 + d2 = RTT, the estimate is off by ``(d1 - d2) / 2``, i.e.
+    ``|error| <= RTT / 2`` — so the MINIMUM-RTT sample across sweeps is
+    kept (the bound only ever tightens) and the bound is recorded as
+    ``clock_skew_bound_ms``. perf_counter clocks do not step, so a
+    latched min-RTT sample never goes stale over a serving run."""
+
+    __slots__ = ("offset_ms", "bound_ms", "rtt_ms", "samples")
+
+    def __init__(self):
+        self.offset_ms: Optional[float] = None
+        self.bound_ms: Optional[float] = None
+        self.rtt_ms: Optional[float] = None
+        self.samples = 0
+
+    def observe(self, t0_ms: float, t1_ms: float,
+                remote_ms: float) -> bool:
+        """Feed one poll's sample; True when it became the new best
+        (lower RTT → tighter bound) — the caller's cue to re-record."""
+        rtt = float(t1_ms) - float(t0_ms)
+        if rtt < 0:
+            return False  # a clock anomaly, never a usable sample
+        self.samples += 1
+        if self.rtt_ms is None or rtt < self.rtt_ms:
+            self.rtt_ms = rtt
+            self.offset_ms = float(remote_ms) - (
+                float(t0_ms) + float(t1_ms)
+            ) / 2.0
+            self.bound_ms = rtt / 2.0
+            return True
+        return False
+
+
+#: pooled keep-alive connections kept per host (overflow closes eagerly)
+_POOL_CAP = 4
+
+
 @dataclass
 class _HostHandle:
     """One fleet member as the gateway sees it."""
@@ -297,12 +367,47 @@ class _HostHandle:
     #: EWMA of observed host service time (ms) — the deadline-shed
     #: queue-estimate multiplier; None until the first response
     ewma_ms: Optional[float] = None
+    #: the health sweep's Cristian clock estimate for this host
+    clock: ClockOffsetEstimator = field(
+        default_factory=ClockOffsetEstimator
+    )
+    #: idle keep-alive connections (satellite of the forward path; the
+    #: health poller keeps using fresh sockets — its RTT IS the clock
+    #: estimator's input and must not ride a warm connection's luck)
+    pool: List[http.client.HTTPConnection] = field(default_factory=list)
+    pool_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def conn(self, timeout: float) -> http.client.HTTPConnection:
         host, _, port = self.address.rpartition(":")
         return http.client.HTTPConnection(
             host, int(port), timeout=timeout
         )
+
+    def acquire(self, timeout: float) -> Tuple[
+            http.client.HTTPConnection, bool]:
+        """A connection to this host: a pooled keep-alive when one is
+        idle (True — reused), else a fresh socket (False)."""
+        with self.pool_lock:
+            while self.pool:
+                c = self.pool.pop()
+                if c.sock is not None:
+                    return c, True
+                c.close()
+        return self.conn(timeout), False
+
+    def release(self, conn: http.client.HTTPConnection) -> None:
+        """Return a healthy keep-alive to the pool (overflow closes)."""
+        with self.pool_lock:
+            if len(self.pool) < _POOL_CAP:
+                self.pool.append(conn)
+                return
+        conn.close()
+
+    def drain_pool(self) -> None:
+        with self.pool_lock:
+            conns, self.pool = self.pool, []
+        for c in conns:
+            c.close()
 
 
 @dataclass
@@ -329,15 +434,20 @@ class Gateway:
         the gateway's lifetime; ring positions come from the SORTED
         host ids.
     :param sink: optional telemetry sink for the schema-v13 ``gateway``
-        records (shed / rehome / rollup).
+        records (shed / rehome / rollup; since v14 also clock).
     :param start_health_loop: start the background /healthz poller
         (pass False in tests that drive ``poll_once()`` by hand).
+    :param tracer: optional ``telemetry.tracing.Tracer`` (pass one built
+        with ``process='gateway'`` / ``span_prefix='gw-'``); None keeps
+        every request on the NULL_TRACER one-attribute-check path and
+        the forward frames byte-identical to the v13 wire.
     """
 
     def __init__(self, cfg, hosts, sink=None,
                  start_health_loop: bool = True,
                  connect_timeout_s: float = 2.0,
-                 request_timeout_s: float = 600.0):
+                 request_timeout_s: float = 600.0,
+                 tracer=None):
         if isinstance(hosts, dict):
             members = {str(k): str(v) for k, v in hosts.items()}
         else:
@@ -363,10 +473,22 @@ class Gateway:
             for hid in sorted(members)
         ]
         self._lock = threading.Lock()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.admitted = 0
+        self.admitted_by_priority: Dict[int, int] = {}
         self.shed: Dict[str, int] = {"admission": 0, "deadline": 0}
         self.rehomes = 0
         self.forward_failures = 0
+        self.pool_reused = 0
+        self.pool_fresh = 0
+        self.pool_retries = 0
+        self._req_ids = itertools.count(1)
+        # admitted-request latency at the edge (arrival → response) —
+        # the /metrics histogram family; LogHistogram so the exposition
+        # and any offline consumer share one exact ladder
+        from .metrics import LogHistogram
+
+        self.admitted_ms_hist = LogHistogram()
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         if start_health_loop:
@@ -403,10 +525,18 @@ class Gateway:
         """One health sweep: refresh readiness + queue depth for every
         untripped host; a host that stops answering AFTER it was ready
         is tripped (latched). Never-ready hosts are left unready, not
-        tripped — they may still be warming up."""
+        tripped — they may still be warming up.
+
+        Each poll doubles as one Cristian clock sample: ``t0``/``t1``
+        stamped around the GET plus the host's own ``perf_ms`` reply
+        feed ``ClockOffsetEstimator``; whenever a lower-RTT sample
+        tightens the bound, a ``gateway`` ``event='clock'`` record pins
+        the new estimate in the log (the LAST clock record per host is
+        always the best one — what ``cli trace --fleet`` aligns with)."""
         for h in self.ring:
             if h.tripped:
                 continue
+            t0 = time.perf_counter()
             try:
                 status, payload = self._get_json(
                     h, "/healthz", self.connect_timeout_s
@@ -415,12 +545,30 @@ class Gateway:
                 if h.was_ready:
                     self._trip(h, e)
                 continue
+            t1 = time.perf_counter()
             with self._lock:
                 h.ready = status == 200
                 if h.ready:
                     h.was_ready = True
                 if isinstance(payload, dict):
                     h.depth = int(payload.get("queue_depth", h.depth))
+            remote_ms = (
+                payload.get("perf_ms") if isinstance(payload, dict)
+                else None
+            )
+            if (
+                status == 200
+                and isinstance(remote_ms, (int, float))
+                and not isinstance(remote_ms, bool)
+                and h.clock.observe(t0 * 1e3, t1 * 1e3, float(remote_ms))
+            ):
+                self._record(
+                    event="clock", host=h.host_id,
+                    clock_offset_ms=round(h.clock.offset_ms, 3),
+                    clock_skew_bound_ms=round(h.clock.bound_ms, 3),
+                    rtt_ms=round(h.clock.rtt_ms, 3),
+                    samples=h.clock.samples,
+                )
 
     def _health_loop(self) -> None:
         while not self._stop.is_set():
@@ -461,6 +609,7 @@ class Gateway:
             h.trip_cause = cause
             stranded = h.in_flight
             self.rehomes += 1
+        h.drain_pool()
         self._record(
             event="rehome", host=h.host_id, cause=repr(cause),
             in_flight=stranded,
@@ -508,13 +657,68 @@ class Gateway:
                 )
         return None
 
+    def _forward(self, host: _HostHandle,
+                 body: bytes) -> Tuple[int, bytes, bool]:
+        """POST one wire frame to ``host`` over a pooled keep-alive.
+
+        Retry-once semantics: a REUSED connection that fails
+        mid-request is usually a keep-alive the host's HTTP server
+        closed between requests, not a host death — it earns exactly
+        one retry on a guaranteed-fresh socket (never another pool
+        entry: a pool full of stale sockets must not spend the whole
+        retry budget). A FRESH socket's failure propagates immediately,
+        so connection-refused still trips the host on the first try
+        (the between-sweeps death semantics are unchanged). Returns
+        ``(status, payload, reused)``."""
+        use_pool = True
+        while True:
+            if use_pool:
+                conn, reused = host.acquire(self.request_timeout_s)
+            else:
+                conn, reused = host.conn(self.request_timeout_s), False
+            with self._lock:
+                if reused:
+                    self.pool_reused += 1
+                else:
+                    self.pool_fresh += 1
+            try:
+                conn.request(
+                    "POST", "/v1/serve", body=body,
+                    headers={"Content-Type": WIRE_CONTENT_TYPE},
+                )
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                if reused:
+                    use_pool = False
+                    with self._lock:
+                        self.pool_retries += 1
+                    continue
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                host.release(conn)
+            return resp.status, payload, reused
+
     def handle_serve(self, body: bytes) -> Tuple[int, str, bytes]:
         """Serve one wire-framed request end to end; returns
         ``(http_status, content_type, response_body)``. 200 carries the
         host's response frame verbatim; everything else is typed JSON
         (shed / host_down / bad_request) — a client can always tell WHY
-        it was refused."""
+        it was refused.
+
+        With a tracer wired, this is where the fleet trace is minted:
+        root ``request`` span (backdated to edge arrival),
+        ``gateway_queue`` until the first forward, one ``forward`` +
+        ``wire`` child pair per attempt (re-home retries are siblings
+        under the same root), zero-duration ``shed`` spans for typed
+        rejections — and the forward header carries the trace baggage
+        the host's batcher adopts. Tracer off: ``root`` stays None and
+        the forwarded header is key-identical to the v13 wire."""
         t_edge = time.perf_counter()
+        tracer = self.tracer
         try:
             request, header = decode_request(body)
             fingerprint = request_fingerprint(request)
@@ -528,7 +732,26 @@ class Gateway:
         home_idx = home_replica(fingerprint, len(self.ring))
         hlen = struct.unpack_from(">I", body)[0]
         blob = body[4 + hlen:]
+        root = gq = None
+        request_id = None
+        if tracer.enabled:
+            request_id = f"{tracer.trace_id}-g{next(self._req_ids):06d}"
+            # each edge request is its OWN causal tree: mint a fresh
+            # trace id here rather than inheriting the tracer's
+            # run-scoped one — `cli trace --fleet` groups by trace_id,
+            # so sharing one would fuse every request into a single
+            # unreadable "trace"
+            root = tracer.start_span(
+                "request", cat="gateway", start_ms=t_edge * 1e3,
+                trace_id=new_trace_id(), request_id=request_id,
+                tenant_id=header.get("tenant_id"), priority=priority,
+            )
+            gq = tracer.start_span(
+                "gateway_queue", cat="gateway", parent=root,
+                start_ms=t_edge * 1e3,
+            )
         causes: List[BaseException] = []
+        attempt = 0
         while True:
             host = self._pick(home_idx)
             if host is None:
@@ -538,6 +761,8 @@ class Gateway:
                 )
                 if causes:
                     err.__cause__ = causes[-1]
+                tracer.end_span(gq)
+                tracer.end_span(root, outcome="host_down")
                 return 503, "application/json", json.dumps({
                     "error": "host_down",
                     "detail": str(err),
@@ -548,16 +773,44 @@ class Gateway:
             if shed is not None:
                 with self._lock:
                     self.shed[shed.reason] += 1
+                trace_fields: Dict[str, Any] = {}
+                if root is not None:
+                    trace_fields = {
+                        "trace_id": root.trace_id,
+                        "request_id": request_id,
+                    }
                 self._record(
                     event="shed", reason=shed.reason,
                     tenant_id=header.get("tenant_id"),
                     priority=priority, deadline_ms=deadline_ms,
-                    host=shed.host, **shed.detail,
+                    host=shed.host, **shed.detail, **trace_fields,
                 )
+                if root is not None:
+                    tracer.end_span(gq)
+                    gq = None
+                    # a zero-duration annotated marker: the rejection
+                    # is an instant, not an interval
+                    sp = tracer.start_span(
+                        "shed", cat="gateway", parent=root,
+                        reason=shed.reason, host=shed.host,
+                    )
+                    tracer.end_span(sp, end_ms=sp.start_ms)
+                    tracer.end_span(
+                        root, outcome="shed", reason=shed.reason
+                    )
                 return 429, "application/json", json.dumps({
                     "error": "shed", "reason": shed.reason,
                     "host": shed.host, **shed.detail,
                 }).encode()
+            if gq is not None:
+                tracer.end_span(gq)
+                gq = None
+            fspan = None
+            if root is not None:
+                fspan = tracer.start_span(
+                    "forward", cat="gateway", parent=root,
+                    host=host.host_id, attempt=attempt,
+                )
             # re-stamp the edge share per attempt (retries after a trip
             # have spent more of the budget) and forward the ORIGINAL
             # buffer bytes — the arrays are never re-encoded
@@ -566,21 +819,29 @@ class Gateway:
             fwd_header["gateway_elapsed_ms"] = round(
                 (time.perf_counter() - t_edge) * 1e3, 3
             )
+            if fspan is not None:
+                # the trace baggage the host-side batcher adopts; only
+                # present while tracing — with it absent the header is
+                # key-identical to the v13 wire, bytes and all
+                fwd_header["trace_id"] = fspan.trace_id
+                fwd_header["parent_span_id"] = fspan.span_id
+                fwd_header["request_id"] = request_id
+                if host.clock.offset_ms is not None:
+                    fwd_header["clock_offset_ms"] = round(
+                        host.clock.offset_ms, 3
+                    )
             fwd = _encode_frame(fwd_header, [blob])
             with self._lock:
                 host.in_flight += 1
+            wire = None
+            if fspan is not None:
+                wire = tracer.start_span(
+                    "wire", cat="gateway", parent=fspan,
+                    host=host.host_id,
+                )
             t_fwd = time.perf_counter()
             try:
-                conn = host.conn(self.request_timeout_s)
-                try:
-                    conn.request(
-                        "POST", "/v1/serve", body=fwd,
-                        headers={"Content-Type": WIRE_CONTENT_TYPE},
-                    )
-                    resp = conn.getresponse()
-                    status, payload = resp.status, resp.read()
-                finally:
-                    conn.close()
+                status, payload, reused = self._forward(host, fwd)
             except (OSError, http.client.HTTPException) as e:
                 # the between-sweeps death path: fail fast, trip, and
                 # re-home THIS request on the ring walk (idempotent by
@@ -589,17 +850,36 @@ class Gateway:
                     host.in_flight -= 1
                     self.forward_failures += 1
                 causes.append(e)
+                tracer.end_span(wire, outcome="error", error=repr(e))
+                tracer.end_span(fspan, outcome="rehome")
                 self._trip(host, e)
+                attempt += 1
                 continue
             rtt_ms = (time.perf_counter() - t_fwd) * 1e3
+            tracer.end_span(wire, status=status, reused=reused)
+            tracer.end_span(
+                fspan,
+                outcome="ok" if status == 200 else f"http_{status}",
+            )
             with self._lock:
                 host.in_flight -= 1
                 if status == 200:
                     self.admitted += 1
+                    self.admitted_by_priority[priority] = (
+                        self.admitted_by_priority.get(priority, 0) + 1
+                    )
+                    self.admitted_ms_hist.observe(
+                        (time.perf_counter() - t_edge) * 1e3
+                    )
                     host.ewma_ms = (
                         rtt_ms if host.ewma_ms is None
                         else 0.7 * host.ewma_ms + 0.3 * rtt_ms
                     )
+            tracer.end_span(
+                root,
+                outcome="served" if status == 200 else "error",
+                status=status,
+            )
             ctype = WIRE_CONTENT_TYPE if status == 200 else (
                 "application/json"
             )
@@ -609,6 +889,7 @@ class Gateway:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            pool_total = self.pool_reused + self.pool_fresh
             return {
                 "hosts": [
                     {
@@ -625,14 +906,104 @@ class Gateway:
                             round(h.ewma_ms, 3) if h.ewma_ms is not None
                             else None
                         ),
+                        "clock_offset_ms": (
+                            round(h.clock.offset_ms, 3)
+                            if h.clock.offset_ms is not None else None
+                        ),
+                        "clock_skew_bound_ms": (
+                            round(h.clock.bound_ms, 3)
+                            if h.clock.bound_ms is not None else None
+                        ),
                     }
                     for h in self.ring
                 ],
                 "admitted": self.admitted,
+                "admitted_by_priority": {
+                    str(p): n
+                    for p, n in sorted(self.admitted_by_priority.items())
+                },
                 "shed": dict(self.shed),
                 "rehomes": self.rehomes,
                 "forward_failures": self.forward_failures,
+                "conn_pool": {
+                    "reused": self.pool_reused,
+                    "fresh": self.pool_fresh,
+                    "retries": self.pool_retries,
+                    "reuse_rate": (
+                        round(self.pool_reused / pool_total, 4)
+                        if pool_total else None
+                    ),
+                },
             }
+
+    def render_metrics(self) -> str:
+        """The gateway's Prometheus text-format (0.0.4) payload — the
+        edge twin of ``ServingMetrics.render``, built from the same
+        serving/metrics.py exposition helpers so the formats can never
+        drift: typed shed counters, the rehome/forward-failure
+        counters, per-priority admitted counters, connection-pool
+        reuse, a ready-host gauge, and the admitted-latency
+        ``LogHistogram`` as a real histogram family (exact cumulative
+        buckets, the ladder shared with every rollup consumer)."""
+        from .metrics import _render_labeled
+
+        with self._lock:
+            shed = dict(self.shed)
+            admitted = {
+                f'priority="{p}"': n
+                for p, n in self.admitted_by_priority.items()
+            }
+            lines = _render_labeled(
+                "gateway_shed_total",
+                "Requests rejected typed at the fleet edge, by reason",
+                "counter",
+                {f'reason="{r}"': n for r, n in shed.items()},
+                scalar=False,
+            )
+            lines += _render_labeled(
+                "gateway_admitted_total",
+                "Requests admitted and served 200, by priority tier",
+                "counter", admitted, scalar=False,
+            )
+            lines += _render_labeled(
+                "gateway_rehomes_total",
+                "Hosts tripped out of the serving ring",
+                "counter", {"": self.rehomes},
+            )
+            lines += _render_labeled(
+                "gateway_forward_failures_total",
+                "Forward attempts that failed at the socket layer",
+                "counter", {"": self.forward_failures},
+            )
+            lines += _render_labeled(
+                "gateway_conn_pool_reused_total",
+                "Forwards served over a pooled keep-alive connection",
+                "counter", {"": self.pool_reused},
+            )
+            lines += _render_labeled(
+                "gateway_conn_pool_fresh_total",
+                "Forwards that opened a fresh connection",
+                "counter", {"": self.pool_fresh},
+            )
+            lines += _render_labeled(
+                "gateway_conn_pool_retries_total",
+                "Stale keep-alives retried once on a fresh socket",
+                "counter", {"": self.pool_retries},
+            )
+            lines += _render_labeled(
+                "gateway_ready_hosts",
+                "Fleet hosts currently ready (untripped, healthz 200)",
+                "gauge",
+                {"": sum(
+                    1 for h in self.ring if h.ready and not h.tripped
+                )},
+            )
+            lines += self.admitted_ms_hist.render(
+                "gateway_admitted_latency_ms",
+                "End-to-end latency of admitted requests at the edge "
+                "(arrival to response, milliseconds)",
+            )
+        return "\n".join(lines) + "\n"
 
     def rollup(self) -> Dict[str, Any]:
         """The fleet aggregate: per-host rollups fetched live, their
@@ -705,7 +1076,9 @@ class GatewayServer:
     """The one fleet endpoint: POST ``/v1/serve`` (wire frames in/out),
     GET ``/healthz`` (200 once >= 1 host is ready — the fleet is
     serving), GET ``/stats`` (membership + admission counters), GET
-    ``/rollup`` (the exact-merge fleet aggregate). ``port=0`` binds an
+    ``/rollup`` (the exact-merge fleet aggregate), GET ``/metrics``
+    (Prometheus text format: the edge counters + the admitted-latency
+    histogram family). ``port=0`` binds an
     ephemeral port (the CI shape); stdlib ``ThreadingHTTPServer``, one
     thread per connection, same as serving/metrics.py."""
 
@@ -760,6 +1133,11 @@ class GatewayServer:
                     self._send(
                         200, "application/json",
                         json.dumps(gw.rollup()).encode(),
+                    )
+                elif self.path == "/metrics":
+                    self._send(
+                        200, "text/plain; version=0.0.4",
+                        gw.render_metrics().encode(),
                     )
                 else:
                     self._send(404, "text/plain", b"not found\n")

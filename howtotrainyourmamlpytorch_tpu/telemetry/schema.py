@@ -132,7 +132,9 @@ Record kinds:
   rejected request and its ``host`` home assignment), ``rehome`` (a host
   left the serving ring: the tripped ``host``, the chained root
   ``cause``, and ``in_flight`` — how many stranded socket requests were
-  failed immediately with that cause instead of hanging), and ``rollup``
+  failed immediately with that cause instead of hanging), ``clock``
+  (since v14: the health sweep's Cristian clock-offset estimate for one
+  ``host`` — see the v14 migration note), and ``rollup``
   (the fleet condensed: ``hosts`` / ``healthy_hosts``, admitted /
   shed-by-reason counts, and the EXACT bucket-wise merge of every
   host's ``adapt_ms_hist`` / ``queue_ms_hist`` log histograms — fleet
@@ -146,10 +148,12 @@ Record kinds:
   the run-scoped ``trace_id``, ``span_id`` / optional ``parent_id``
   (the Dapper-style tree), ``start_ms`` / ``dur_ms`` (perf_counter
   milliseconds — one process-wide monotonic origin, so cross-thread
-  ordering is real), ``tid`` (thread name) and a small ``attrs``
-  payload (program / bucket / shots / request_id / iter). ``cli
-  trace`` assembles these into a Chrome/Perfetto timeline and the
-  critical-path summary.
+  ordering is real), ``tid`` (thread name), since v14 an optional
+  ``process`` label (the emitting fleet process — ``gateway`` or a
+  host id) and a small ``attrs`` payload (program / bucket / shots /
+  request_id / iter). ``cli trace`` assembles these into a
+  Chrome/Perfetto timeline and the critical-path summary; ``cli trace
+  --fleet`` merges the per-process logs into one clock-aligned export.
 
 Version history / migration notes:
 
@@ -269,6 +273,26 @@ Version history / migration notes:
   (``tests/fixtures/telemetry_v12_schema.jsonl`` pins a v12-era log)
   and the forward-compat rules carry over (the future-schema fixture
   is re-pinned at v14-unknown).
+* **v14** — fleet-wide distributed tracing (gateway ↔ host trace
+  propagation over the wire): ``span`` records gain the optional
+  top-level ``process`` field (the emitting process's fleet identity —
+  ``gateway`` or a host id — stamped by per-process tracers so ``cli
+  trace --fleet`` can assign Perfetto process tracks), host-side
+  request roots adopted from a gateway parent carry the wire-delivered
+  ``clock_offset_ms`` attr, and the ``gateway`` kind grows two things:
+  a new ``event='clock'`` shape (the health sweep's Cristian clock
+  estimate for one ``host`` — ``clock_offset_ms``, the error bound
+  ``clock_skew_bound_ms`` = RTT/2 of the min-RTT sample, and
+  ``rtt_ms`` — emitted whenever a lower-RTT sample tightens the bound,
+  so the LAST clock record per host is always the best estimate) and
+  optional ``trace_id`` / ``request_id`` fields on ``shed`` records
+  (a typed rejection is joinable to its zero-duration shed span).
+  Pure addition — no new kinds, no new REQUIRED fields (``gateway``
+  still requires only ``event``; ``span`` required fields unchanged):
+  every v1..v13 record validates unchanged
+  (``tests/fixtures/telemetry_v13_schema.jsonl`` pins a v13-era log)
+  and the forward-compat rules carry over (the future-schema fixture
+  is re-pinned at v15-unknown).
 """
 
 from __future__ import annotations
@@ -276,7 +300,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterator, Tuple
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 #: oldest version this validator fully understands (v1 is a strict subset)
 MIN_SCHEMA_VERSION = 1
 

@@ -29,13 +29,24 @@ and the fault seams):
   stack (``threading.local``), and a span can be parented EXPLICITLY
   (``parent=``, or ``use_parent()`` around a region) so a request
   submitted on one thread nests the dispatch work a worker thread did
-  for it.
+  for it;
+* **causality across processes** — a span parented under a
+  ``remote_span`` (trace/span ids that arrived over the wire, e.g. in
+  the gateway's forward-frame header) INHERITS the remote trace id, so
+  a fleet host's ``queue→assemble→dispatch→sync`` tree hangs under the
+  gateway's ``forward`` span in the merged export. Per-process tracers
+  take a ``span_prefix`` (span ids stay unique across the merged fleet
+  log) and a ``process`` label (stamped on every emitted record so
+  ``cli trace --fleet`` can assign per-process Perfetto tracks). The
+  clocks themselves never cross the wire: each process records its own
+  perf_counter origin, and the gateway's health sweep estimates each
+  host's clock offset (Cristian) so the merge can align them offline.
 
-Record shape (``kind='span'``, schema v10): ``name``, ``cat``,
-``trace_id`` (run-scoped), ``span_id``, ``start_ms`` / ``dur_ms``
-(perf_counter based), optional ``parent_id``, ``tid`` (thread name) and
-``attrs`` (small JSON payload: program / bucket / shots / request_id /
-iter ...).
+Record shape (``kind='span'``, schema v10; since v14 optionally
+``process``): ``name``, ``cat``, ``trace_id`` (run-scoped), ``span_id``,
+``start_ms`` / ``dur_ms`` (perf_counter based), optional ``parent_id``,
+``tid`` (thread name) and ``attrs`` (small JSON payload: program /
+bucket / shots / request_id / iter ...).
 
 Pure stdlib — importable without jax or numpy, so the exporters below
 run on a laptop against a scp'd log.
@@ -55,20 +66,44 @@ __all__ = [
     "Tracer",
     "NULL_TRACER",
     "new_trace_id",
+    "remote_span",
     "span_records",
     "to_chrome_trace",
     "critical_path_summary",
+    "fleet_critical_path",
     "SERVING_STAGES",
+    "FLEET_STAGES",
 ]
 
 #: the serving decomposition stages, in causal order (queue wait in the
 #: micro-batcher, host batch assembly, device dispatch enqueue, host sync)
 SERVING_STAGES = ("queue", "assemble", "dispatch", "sync")
 
+#: the fleet decomposition stages, in causal order: edge decode+admission
+#: (gateway_queue), network + host HTTP handling outside the batcher
+#: (wire, net of the host's own request span), then the host-side serving
+#: stages (queue renamed host_queue to disambiguate from the edge wait)
+FLEET_STAGES = ("gateway_queue", "wire", "host_queue",
+                "assemble", "dispatch", "sync")
+
 
 def new_trace_id() -> str:
     """A fresh run-scoped trace id (16 hex chars)."""
     return uuid.uuid4().hex[:16]
+
+
+def remote_span(trace_id: str, span_id: str) -> "Span":
+    """A synthetic handle for a span that lives in ANOTHER process.
+
+    The cross-process adoption hook: a fleet host that received
+    ``trace_id`` / ``parent_span_id`` in the wire header wraps them in a
+    ``remote_span`` and passes it as ``parent=`` — the local root then
+    inherits the remote trace id and parents under the remote span id,
+    so the merged fleet export reassembles one tree. The handle itself
+    is never emitted (it was already emitted by its owning process)."""
+    return Span(name="remote", cat="remote", trace_id=trace_id,
+                span_id=span_id, parent_id=None, start_ms=0.0,
+                tid="", attrs={})
 
 
 class Span:
@@ -99,13 +134,26 @@ class Tracer:
         DISABLES the tracer: every entry point is a single attribute
         check, no span objects are allocated, nothing is emitted.
     :param trace_id: run-scoped id stamped on every span (defaults to a
-        fresh ``new_trace_id()``).
+        fresh ``new_trace_id()``). Spans opened under an explicit remote
+        parent inherit the PARENT's trace id instead (see
+        ``remote_span``).
+    :param span_prefix: prefix for generated span ids (default none —
+        ``s000001`` ...). Fleet processes each pass a distinct prefix
+        (``gw-``, ``host00-``) so span ids stay unique in the merged
+        multi-process log.
+    :param process: when set, stamped as a top-level ``process`` field
+        on every emitted span record (schema v14) — the per-process
+        track label ``cli trace --fleet`` groups by.
     """
 
     def __init__(self, emit: Optional[Callable[..., None]] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 span_prefix: str = "",
+                 process: Optional[str] = None):
         self.enabled = emit is not None
         self.trace_id = trace_id or new_trace_id()
+        self.process = process
+        self._span_prefix = span_prefix
         self._emit = emit
         self._ids = itertools.count(1)
         self._ids_lock = threading.Lock()
@@ -145,11 +193,12 @@ class Tracer:
 
     def _next_id(self) -> str:
         with self._ids_lock:
-            return f"s{next(self._ids):06d}"
+            return f"{self._span_prefix}s{next(self._ids):06d}"
 
     def start_span(self, name: str, cat: str = "default",
                    parent: Optional[Span] = None,
                    start_ms: Optional[float] = None,
+                   trace_id: Optional[str] = None,
                    **attrs: Any) -> Optional[Span]:
         """Open a span; returns ``None`` when the tracer is disabled (the
         off path allocates nothing). ``parent=None`` nests under this
@@ -157,7 +206,10 @@ class Tracer:
         milliseconds) backdates the span to a stamp the caller already
         took — the hot-path pattern: measure with bare perf_counter,
         emit the span AFTER the timed interval so the record's own
-        serialization never rides the numbers it reports."""
+        serialization never rides the numbers it reports. ``trace_id``
+        overrides the inherited id — how the gateway mints a FRESH trace
+        per admitted request (each edge request is its own causal tree,
+        not a twig of a run-wide one)."""
         if not self.enabled:
             return None
         if parent is None:
@@ -165,7 +217,12 @@ class Tracer:
         return Span(
             name=name,
             cat=cat,
-            trace_id=self.trace_id,
+            # inherit the parent's trace id: in-process parents carry this
+            # tracer's own id (no change), a remote_span parent carries the
+            # originating process's — cross-process propagation for free
+            trace_id=(trace_id if trace_id is not None
+                      else parent.trace_id if parent is not None
+                      else self.trace_id),
             span_id=self._next_id(),
             parent_id=parent.span_id if parent is not None else None,
             start_ms=(start_ms if start_ms is not None
@@ -200,6 +257,8 @@ class Tracer:
         }
         if span.parent_id is not None:
             fields["parent_id"] = span.parent_id
+        if self.process is not None:
+            fields["process"] = self.process
         if span.attrs:
             fields["attrs"] = span.attrs
         emit(**fields)
@@ -243,7 +302,9 @@ def _numeric(value: Any) -> Optional[float]:
     return float(value)
 
 
-def to_chrome_trace(spans: Iterable[dict]) -> Dict[str, Any]:
+def to_chrome_trace(spans: Iterable[dict],
+                    offsets_ms: Optional[Dict[str, float]] = None,
+                    ) -> Dict[str, Any]:
     """Assemble span records into Chrome/Perfetto trace-event JSON.
 
     One complete (``ph='X'``) event per span — ``ts``/``dur`` in
@@ -254,8 +315,20 @@ def to_chrome_trace(spans: Iterable[dict]) -> Dict[str, Any]:
     ``args`` carries span/parent ids and the span attrs, which is what
     lets Perfetto's flow/selection UI reconstruct the causal tree. Spans
     missing their required numeric fields are skipped, never fatal — a
-    truncated log from a crashed run must still render."""
-    tids: Dict[str, int] = {}
+    truncated log from a crashed run must still render.
+
+    Fleet logs (spans carrying a ``process`` label, schema v14) get one
+    Perfetto process track per label — ``pid`` assigned in first-seen
+    order, ``process_name`` metadata, thread ids scoped per process —
+    and ``offsets_ms`` (process label → that process's estimated clock
+    offset vs the reference process, the gateway's Cristian estimate)
+    SHIFTS each process's timestamps onto the reference clock
+    (``ts - offset``), so a host span renders INSIDE the gateway span
+    that caused it. Single-process logs (no ``process`` field anywhere)
+    keep the exact v10 shape: everything on ``pid`` 1, no process
+    metadata."""
+    pids: Dict[str, int] = {}
+    tids: Dict[Any, int] = {}
     events: List[Dict[str, Any]] = []
     for rec in spans:
         start_ms = _numeric(rec.get("start_ms"))
@@ -263,8 +336,18 @@ def to_chrome_trace(spans: Iterable[dict]) -> Dict[str, Any]:
         name = rec.get("name")
         if start_ms is None or dur_ms is None or not isinstance(name, str):
             continue
+        process = rec.get("process")
+        process = process if isinstance(process, str) else None
+        if process is not None:
+            pid = pids.setdefault(process, len(pids) + 1)
+            if offsets_ms:
+                off = offsets_ms.get(process)
+                if isinstance(off, (int, float)):
+                    start_ms -= float(off)
+        else:
+            pid = 1
         tid_name = str(rec.get("tid", "main"))
-        tid = tids.setdefault(tid_name, len(tids) + 1)
+        tid = tids.setdefault((process, tid_name), len(tids) + 1)
         args: Dict[str, Any] = {
             "trace_id": rec.get("trace_id"),
             "span_id": rec.get("span_id"),
@@ -280,20 +363,31 @@ def to_chrome_trace(spans: Iterable[dict]) -> Dict[str, Any]:
             "ph": "X",
             "ts": round(start_ms * 1e3, 1),
             "dur": max(0.0, round(dur_ms * 1e3, 1)),
-            "pid": 1,
+            "pid": pid,
             "tid": tid,
             "args": args,
         })
     events.sort(key=lambda e: e["ts"])
     meta: List[Dict[str, Any]] = [
         {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process},
+        }
+        for process, pid in sorted(pids.items(), key=lambda kv: kv[1])
+    ]
+    meta += [
+        {
             "name": "thread_name",
             "ph": "M",
-            "pid": 1,
+            "pid": pids.get(process, 1) if process is not None else 1,
             "tid": tid,
             "args": {"name": tid_name},
         }
-        for tid_name, tid in sorted(tids.items(), key=lambda kv: kv[1])
+        for (process, tid_name), tid in sorted(
+            tids.items(), key=lambda kv: kv[1])
     ]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
@@ -374,3 +468,129 @@ def critical_path_summary(spans: Iterable[dict]) -> Dict[str, Any]:
         row["requests"] = req["count"]
         out_serving[key] = row
     return {"by_name": by_name, "serving": out_serving}
+
+
+def fleet_critical_path(spans: Iterable[dict]) -> Dict[str, Any]:
+    """Attribute fleet end-to-end latency into the cross-process stages.
+
+    Groups spans by ``trace_id`` (each gateway-minted root is one
+    request), keeps the traces that hold a gateway-side ``request`` root,
+    and decomposes each into ``FLEET_STAGES``:
+
+    * ``gateway_queue`` — edge decode + admission before the first
+      forward attempt;
+    * ``wire`` — the forward socket round trips MINUS the host-side
+      request span: network transit + HTTP framing + host decode, the
+      time neither process's serving stages can see;
+    * ``host_queue`` — the host micro-batcher's ``queue`` span (renamed
+      so the edge wait and the host wait stay distinguishable);
+    * ``assemble`` / ``dispatch`` / ``sync`` — the host serving stages.
+
+    Only durations are compared — never absolute timestamps — so the
+    attribution is exact WITHOUT clock alignment. ``assemble`` /
+    ``dispatch`` / ``sync`` are emitted once per dispatch GROUP (parented
+    under the group leader), so traces that rode along in someone else's
+    group carry only queue+wire; the summary separates ``complete``
+    traces (all stages present) from the total and averages stages over
+    the traces that have them. The ``complete``-trace identity
+    ``sum(stages) ≈ e2e`` is this report's acceptance check (CI gates
+    on ``coverage``)."""
+    traces: Dict[str, Dict[str, Any]] = {}
+    processes: set = set()
+    for rec in spans:
+        dur = _numeric(rec.get("dur_ms"))
+        name = rec.get("name")
+        trace_id = rec.get("trace_id")
+        if dur is None or not isinstance(name, str) or not trace_id:
+            continue
+        proc = rec.get("process")
+        if isinstance(proc, str):
+            processes.add(proc)
+        entry = traces.setdefault(
+            trace_id,
+            {"root_ms": None, "shed": False, "procs": set(),
+             "sums": {}, "host_request_ms": 0.0},
+        )
+        if isinstance(proc, str):
+            entry["procs"].add(proc)
+        cat = rec.get("cat")
+        if name == "request" and cat == "gateway":
+            entry["root_ms"] = dur
+        elif name == "shed" and cat == "gateway":
+            entry["shed"] = True
+        elif name == "request" and cat == "serving":
+            entry["host_request_ms"] += dur
+        else:
+            stage = None
+            if name == "gateway_queue" and cat == "gateway":
+                stage = "gateway_queue"
+            elif name == "wire" and cat == "gateway":
+                stage = "wire"
+            elif name == "queue" and cat == "serving":
+                stage = "host_queue"
+            elif name in ("assemble", "dispatch", "sync") and cat == "serving":
+                stage = name
+            elif name == "device_hold" and cat == "serving":
+                # emulated device occupancy (serve-bench's CPU shim):
+                # device time, so it belongs to the dispatch stage
+                stage = "dispatch"
+            if stage is not None:
+                entry["sums"][stage] = entry["sums"].get(stage, 0.0) + dur
+    requests = 0
+    sheds = 0
+    spanning = 0
+    complete_rows: List[Dict[str, float]] = []
+    stage_sums: Dict[str, Dict[str, float]] = {
+        s: {"count": 0, "total_ms": 0.0} for s in FLEET_STAGES
+    }
+    for entry in traces.values():
+        if entry["root_ms"] is None:
+            continue  # a host-local trace (no gateway root): not a fleet e2e
+        if entry["shed"]:
+            sheds += 1
+            continue
+        requests += 1
+        if len(entry["procs"]) >= 2:
+            spanning += 1
+        sums = dict(entry["sums"])
+        if "wire" in sums:
+            # net of the host's own request span: what's left is transit
+            # + framing + host decode — clamped, durations only
+            sums["wire"] = max(0.0, sums["wire"] - entry["host_request_ms"])
+        for stage, total in sums.items():
+            slot = stage_sums[stage]
+            slot["count"] += 1
+            slot["total_ms"] += total
+        if all(s in sums for s in FLEET_STAGES):
+            complete_rows.append(
+                {"e2e_ms": entry["root_ms"],
+                 "stage_sum_ms": sum(sums[s] for s in FLEET_STAGES)}
+            )
+    out: Dict[str, Any] = {
+        "requests": requests,
+        "sheds": sheds,
+        "spanning_traces": spanning,
+        "complete": len(complete_rows),
+        "processes": sorted(processes),
+    }
+    stages: Dict[str, Any] = {}
+    for stage in FLEET_STAGES:
+        slot = stage_sums[stage]
+        stages[f"{stage}_ms_mean"] = (
+            round(slot["total_ms"] / slot["count"], 3)
+            if slot["count"] else None
+        )
+        stages[f"{stage}_count"] = slot["count"]
+    out["stages"] = stages
+    if complete_rows:
+        e2e = sum(r["e2e_ms"] for r in complete_rows) / len(complete_rows)
+        ssum = sum(r["stage_sum_ms"] for r in complete_rows) / len(
+            complete_rows)
+        out["e2e_ms_mean"] = round(e2e, 3)
+        out["stage_sum_ms_mean"] = round(ssum, 3)
+        out["coverage"] = round(ssum / e2e, 4) if e2e > 0 else None
+    else:
+        out["e2e_ms_mean"] = None
+        out["stage_sum_ms_mean"] = None
+        out["coverage"] = None
+    return out

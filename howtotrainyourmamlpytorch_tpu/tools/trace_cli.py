@@ -16,11 +16,23 @@ critical-path summary:
 * any on-demand device-profile windows (``trace`` records) captured
   during the run, linked by trace id to the host spans.
 
+``--fleet`` merges a gateway log with its per-host shards
+(``log.hostNN.jsonl`` siblings auto-discovered exactly like ``cli slo
+--fleet``) into ONE Perfetto export: one process track per emitting
+process (gateway + each host), host timestamps shifted onto the
+gateway clock by the health sweep's Cristian offset estimate (the LAST
+``gateway``/``clock`` record per host is the tightest bound), and a
+fleet critical-path summary attributing mean e2e into
+gateway_queue / wire / host_queue / assemble / dispatch / sync.
+Passing several logs without ``--fleet`` is refused (exit 2) — a
+single-run timeline over unrelated logs would be meaningless.
+
 .. code-block:: console
 
    python -m howtotrainyourmamlpytorch_tpu.cli trace LOG
    python -m howtotrainyourmamlpytorch_tpu.cli trace LOG --out run.trace.json
    python -m howtotrainyourmamlpytorch_tpu.cli trace LOG --json
+   python -m howtotrainyourmamlpytorch_tpu.cli trace GATEWAY_LOG --fleet
 
 Pure stdlib + ``telemetry`` (no jax, no numpy) — dispatched jax-free by
 ``cli.py`` like ``inspect``, so a scp'd log renders on a laptop. Exit 0
@@ -38,11 +50,14 @@ from typing import Any, Dict, List, Optional
 
 from ..telemetry.schema import iter_records
 from ..telemetry.tracing import (
+    FLEET_STAGES,
     SERVING_STAGES,
     critical_path_summary,
+    fleet_critical_path,
     span_records,
     to_chrome_trace,
 )
+from .slo_cli import _expand_fleet_logs, _host_label
 
 
 def _profile_windows(records: List[dict]) -> List[Dict[str, Any]]:
@@ -62,6 +77,23 @@ def _profile_windows(records: List[dict]) -> List[Dict[str, Any]]:
     return out
 
 
+def clock_offsets(records: List[dict]) -> Dict[str, float]:
+    """Per-host clock offsets from the gateway's ``event='clock'``
+    records (Cristian estimates emitted by the health sweep). Records
+    are emitted only when the min-RTT sample improves, so the LAST one
+    per host carries the tightest ``clock_skew_bound_ms`` — later
+    records simply overwrite earlier ones here."""
+    offsets: Dict[str, float] = {}
+    for rec in records:
+        if rec.get("kind") != "gateway" or rec.get("event") != "clock":
+            continue
+        host = rec.get("host")
+        off = rec.get("clock_offset_ms")
+        if isinstance(host, str) and isinstance(off, (int, float)):
+            offsets[host] = float(off)
+    return offsets
+
+
 def default_out_path(log: str) -> str:
     base = log[:-6] if log.endswith(".jsonl") else log
     return base + ".trace.json"
@@ -73,7 +105,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Render span telemetry as a Chrome/Perfetto trace + "
                     "critical-path summary (jax-free)",
     )
-    parser.add_argument("log", help="telemetry JSONL (logs/telemetry.jsonl)")
+    parser.add_argument("log", nargs="+",
+                        help="telemetry JSONL path (with --fleet: the "
+                             "gateway log; its log.hostNN.jsonl shards "
+                             "are auto-discovered)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="merge the gateway log with its per-host "
+                             "shards into one clock-aligned Perfetto "
+                             "export + fleet critical-path summary")
     parser.add_argument("--out", default=None, metavar="PATH",
                         help="Chrome trace-event JSON output path "
                              "(default: <log>.trace.json); '-' skips the "
@@ -82,8 +121,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="machine-readable summary on stdout")
     args = parser.parse_args(argv)
 
+    if not args.fleet and len(args.log) > 1:
+        print("error: several logs need --fleet (a single timeline over "
+              "unrelated logs would be meaningless)", file=sys.stderr)
+        return 2
+
+    logs = _expand_fleet_logs(args.log) if args.fleet else args.log
+    records: List[dict] = []
+    per_log_spans: Dict[str, int] = {}
     try:
-        records = list(iter_records(args.log))
+        for path in logs:
+            recs = list(iter_records(path))
+            per_log_spans[_host_label(path)] = sum(
+                1 for r in recs if r.get("kind") == "span"
+            )
+            records.extend(recs)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -91,11 +143,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     spans = span_records(records)
     summary = critical_path_summary(spans)
     windows = _profile_windows(records)
-    trace = to_chrome_trace(spans)
+    offsets = clock_offsets(records) if args.fleet else {}
+    trace = to_chrome_trace(spans, offsets_ms=offsets or None)
+    fleet = fleet_critical_path(spans) if args.fleet else None
 
     out_path = None
     if args.out != "-":
-        out_path = args.out or default_out_path(args.log)
+        out_path = args.out or default_out_path(logs[0])
         tmp = out_path + ".tmp"
         os.makedirs(
             os.path.dirname(os.path.abspath(out_path)), exist_ok=True
@@ -105,7 +159,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.replace(tmp, out_path)
 
     payload: Dict[str, Any] = {
-        "log": args.log,
+        "log": logs if args.fleet else logs[0],
         "spans": len(spans),
         "trace_events": len(trace["traceEvents"]),
         "out": out_path,
@@ -113,11 +167,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "by_name": summary["by_name"],
         "profile_windows": windows,
     }
+    if args.fleet:
+        payload["clock_offsets_ms"] = offsets
+        payload["fleet"] = fleet
     if args.json:
         print(json.dumps(payload, indent=2, sort_keys=True))
         return 0
 
-    lines = [f"{args.log}: {len(spans)} span(s)"]
+    label = f"fleet[{len(logs)} log(s)]" if args.fleet else logs[0]
+    lines = [f"{label}: {len(spans)} span(s)"]
+    if args.fleet:
+        for tag in sorted(per_log_spans):
+            lines.append(f"    {tag}: {per_log_spans[tag]} span(s)")
     if out_path:
         lines.append(
             f"  chrome trace: {out_path} "
@@ -129,6 +190,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             "  no span records: enable tracing_level='on' (train) or "
             "serve-bench --trace (serving)"
         )
+    if fleet is not None:
+        lines.append(
+            f"  fleet: {fleet['requests']} request(s), "
+            f"{fleet['sheds']} shed(s), {fleet['spanning_traces']} "
+            f"spanning >=2 processes, {fleet['complete']} complete; "
+            f"clock offsets for {len(offsets)} host(s)"
+        )
+        parts = []
+        for stage in FLEET_STAGES:
+            mean = fleet["stages"][f"{stage}_ms_mean"]
+            if mean is not None:
+                parts.append(f"{stage} {mean:.2f}")
+        if parts:
+            lines.append(
+                "  fleet critical path (mean ms): " + ", ".join(parts)
+            )
+        if fleet["e2e_ms_mean"] is not None:
+            lines.append(
+                f"    stage sum {fleet['stage_sum_ms_mean']:.2f} vs "
+                f"e2e {fleet['e2e_ms_mean']:.2f} "
+                f"(coverage {fleet['coverage']:.2f})"
+            )
     if summary["serving"]:
         lines.append("  serving critical path (mean ms per dispatch):")
         for key, row in summary["serving"].items():

@@ -1,0 +1,697 @@
+"""Fleet-wide distributed tracing tests (the cross-process trace path).
+
+Covers the v14 observability surfaces end to end without jax: the
+Cristian clock-offset estimator the health sweep runs, the trace
+baggage the gateway stamps into the wire frame (and the byte-identity
+guarantee when tracing is off), the host-side adoption by the
+MicroBatcher, the clock-aligned multi-process Perfetto export,
+the gateway's Prometheus ``/metrics`` endpoint, and the ``cli trace
+--fleet`` merge. Everything runs over real loopback HTTP against
+stub-backed ``FleetHost`` instances, mirroring tests/test_gateway.py;
+the jax-heavy end-to-end shape is CI's ``fleet-smoke`` job.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.serving import gateway as gw
+from howtotrainyourmamlpytorch_tpu.serving.batcher import (
+    AdaptRequest,
+    MicroBatcher,
+)
+from howtotrainyourmamlpytorch_tpu.serving.fleet import FleetHost
+from howtotrainyourmamlpytorch_tpu.serving.metrics import (
+    LogHistogram,
+    parse_prometheus_text,
+)
+from howtotrainyourmamlpytorch_tpu import telemetry as tel
+from howtotrainyourmamlpytorch_tpu.telemetry.tracing import (
+    Tracer,
+    fleet_critical_path,
+    to_chrome_trace,
+)
+from howtotrainyourmamlpytorch_tpu.tools import trace_cli
+
+
+# -- stubs (the test_gateway.py shapes) --------------------------------------
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+class _FakeResult:
+    def __init__(self, tenant_id="t0", way=3, targets=2):
+        self.tenant_id = tenant_id
+        self.preds = np.arange(
+            way * targets * 5, dtype=np.float32
+        ).reshape(way * targets, 5)
+        self.loss = 0.25
+        self.accuracy = 0.875
+
+
+class _StubPending:
+    def __init__(self, result):
+        self._result = result
+
+    def get(self, timeout=None):
+        return self._result
+
+
+class _StubRouter:
+    def __init__(self):
+        self.submitted = []
+
+    def submit(self, request):
+        self.submitted.append(request)
+        return _StubPending(_FakeResult(request.tenant_id or "t0"))
+
+    def stats(self):
+        return {"submitted": len(self.submitted)}
+
+
+class _StubReplica:
+    def __init__(self, depth=0):
+        self._depth = depth
+
+    def queue_depth(self):
+        return self._depth
+
+
+class _StubPool:
+    def __init__(self, depth=0):
+        self.replicas = [_StubReplica(depth)]
+
+    def readiness(self):
+        return {0: True}
+
+    def rollup(self):
+        return {
+            "dispatches": 0, "tenants": 0,
+            "adapt_ms_hist": LogHistogram().to_dict(),
+            "queue_ms_hist": LogHistogram().to_dict(),
+        }
+
+
+def _gw_cfg(**kw):
+    kw.setdefault("serving_gateway_health_interval_s", 0.05)
+    return MAMLConfig(**kw)
+
+
+def _adapt_request(seed=123, **kw):
+    rng = np.random.RandomState(seed)
+    return AdaptRequest(
+        support_x=rng.randn(3, 1, 10, 10, 1).astype(np.float32),
+        support_y=np.tile(np.arange(3, dtype=np.int32)[:, None], (1, 1)),
+        query_x=rng.randn(3, 2, 10, 10, 1).astype(np.float32),
+        query_y=None,
+        **kw,
+    )
+
+
+def _make_tracer(process=None, span_prefix=""):
+    records = []
+
+    def emit(**fields):
+        records.append(fields)
+
+    return Tracer(
+        emit=emit, process=process, span_prefix=span_prefix
+    ), records
+
+
+def _make_fleet(n=2, sink=None, tracer=None, **cfg_kw):
+    hosts, routers, members = {}, {}, {}
+    for i in range(n):
+        router = _StubRouter()
+        host = FleetHost(router, _StubPool(), host_id=f"host{i:02d}")
+        hosts[host.host_id] = host
+        routers[host.host_id] = router
+        members[host.host_id] = f"127.0.0.1:{host.port}"
+    gateway = gw.Gateway(
+        _gw_cfg(**cfg_kw), members, sink=sink, start_health_loop=False,
+        tracer=tracer,
+    )
+    gateway.poll_once()
+    return gateway, hosts, routers
+
+
+def _close_fleet(gateway, hosts):
+    gateway.close()
+    for h in hosts.values():
+        h.close()
+
+
+# -- Cristian clock-offset estimator -----------------------------------------
+
+
+def test_clock_offset_error_bounded_by_half_rtt():
+    """Cristian's bound, with the asymmetry adversary: the remote stamp
+    lands anywhere inside the RTT window, and however lopsided the
+    request/response legs are, |estimate - truth| <= RTT/2 — the bound
+    the estimator reports as clock_skew_bound_ms."""
+    true_offset = 12_345.678  # remote clock runs this far ahead
+    for d1, d2 in ((0.4, 0.4), (0.79, 0.01), (0.05, 0.95), (2.0, 0.0)):
+        est = gw.ClockOffsetEstimator()
+        t0 = 1000.0
+        t1 = t0 + d1 + d2
+        # the remote stamps its clock AFTER the request leg (d1 in)
+        remote = (t0 + d1) + true_offset
+        assert est.observe(t0, t1, remote) is True
+        assert est.bound_ms == pytest.approx((d1 + d2) / 2)
+        assert abs(est.offset_ms - true_offset) <= est.bound_ms + 1e-9
+
+
+def test_clock_offset_bound_monotone_across_sweeps():
+    """Only a strictly-smaller RTT replaces the latched estimate, so the
+    recorded bound never loosens across health sweeps; non-causal
+    samples (t1 < t0) are rejected without counting."""
+    est = gw.ClockOffsetEstimator()
+    bounds = []
+    adopted = []
+    for rtt in (3.0, 1.0, 2.5, 0.4, 0.4, 8.0):
+        took = est.observe(100.0, 100.0 + rtt, 5100.0 + rtt / 2)
+        adopted.append(took)
+        bounds.append(est.bound_ms)
+    assert adopted == [True, True, False, True, False, False]
+    assert all(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:]))
+    assert est.samples == 6
+    before = (est.offset_ms, est.bound_ms, est.samples)
+    assert est.observe(100.0, 99.0, 5100.0) is False  # clock went back?
+    assert (est.offset_ms, est.bound_ms, est.samples) == before
+
+
+def test_health_sweep_emits_tightening_clock_records():
+    """poll_once runs the estimator against the real /healthz perf_ms
+    stamp and records event='clock' only when the min-RTT sample
+    improves — the LAST record per host is the authoritative offset
+    `cli trace --fleet` reads."""
+    sink = _ListSink()
+    gateway, hosts, _ = _make_fleet(n=2, sink=sink)
+    try:
+        for _ in range(4):
+            gateway.poll_once()
+        clocks = [
+            r for r in sink.records
+            if r.get("kind") == "gateway" and r.get("event") == "clock"
+        ]
+        assert clocks, "health sweep emitted no clock records"
+        hosts_seen = {r["host"] for r in clocks}
+        assert hosts_seen == set(hosts)
+        for r in clocks:
+            tel.validate_record(r)
+            # both fields are independently rounded to 3 decimals
+            assert r["clock_skew_bound_ms"] == pytest.approx(
+                r["rtt_ms"] / 2, abs=1.1e-3
+            )
+        # per host, the recorded bound tightens monotonically
+        for hid in hosts_seen:
+            bs = [r["clock_skew_bound_ms"] for r in clocks
+                  if r["host"] == hid]
+            assert all(b2 < b1 for b1, b2 in zip(bs, bs[1:]))
+        st = {h["host_id"]: h for h in gateway.stats()["hosts"]}
+        for hid in hosts:
+            assert st[hid]["clock_skew_bound_ms"] > 0
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+# -- wire baggage: byte-identity off, propagation on -------------------------
+
+
+def _capture_forward(gateway):
+    captured = []
+    orig = gateway._forward
+
+    def spy(host, body):
+        captured.append(body)
+        return orig(host, body)
+
+    gateway._forward = spy
+    return captured
+
+
+def test_wire_frame_byte_identical_when_tracing_off():
+    """Tracing off is the schema-v13 wire, bytes and all: the forwarded
+    header carries exactly the client keys plus the two v13 gateway
+    stamps — no trace keys — and re-encoding the decoded header
+    reproduces the frame bit-for-bit (the encoder serializes only the
+    keys present, so absent baggage can't perturb the bytes)."""
+    gateway, hosts, _ = _make_fleet(n=1)
+    captured = _capture_forward(gateway)
+    try:
+        req = _adapt_request(tenant_id="tenant-1", deadline_ms=500.0)
+        client_keys = set(gw.decode_request(gw.encode_request(req))[1])
+        status, _, _ = gateway.handle_serve(gw.encode_request(req))
+        assert status == 200
+        header, blob = gw._decode_frame(captured[0])
+        assert set(header) == client_keys | {
+            "priority", "gateway_elapsed_ms"
+        }
+        for key in ("trace_id", "parent_span_id", "request_id",
+                    "clock_offset_ms"):
+            assert key not in header
+        assert gw._encode_frame(header, [blob]) == captured[0]
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_trace_baggage_rides_the_wire_and_host_adopts_it():
+    """Tracing on: the forward frame gains exactly the four baggage
+    keys, and the host handler stamps them onto the decoded request as
+    trace_ctx — parenting the host tree under THIS forward span of THIS
+    gateway trace."""
+    tracer, records = _make_tracer(process="gateway", span_prefix="gw-")
+    gateway, hosts, routers = _make_fleet(n=1, tracer=tracer)
+    captured = _capture_forward(gateway)
+    try:
+        req = _adapt_request(tenant_id="tenant-2", deadline_ms=500.0)
+        client_keys = set(gw.decode_request(gw.encode_request(req))[1])
+        status, _, _ = gateway.handle_serve(gw.encode_request(req))
+        assert status == 200
+        header, _ = gw._decode_frame(captured[0])
+        assert set(header) == client_keys | {
+            "priority", "gateway_elapsed_ms", "trace_id",
+            "parent_span_id", "request_id", "clock_offset_ms",
+        }
+        fwd = [r for r in records if r["name"] == "forward"]
+        root = [r for r in records if r["name"] == "request"]
+        assert len(fwd) == 1 and len(root) == 1
+        assert header["trace_id"] == fwd[0]["trace_id"]
+        assert header["parent_span_id"] == fwd[0]["span_id"]
+        assert fwd[0]["parent_id"] == root[0]["span_id"]
+        (request,) = routers["host00"].submitted
+        assert request.trace_ctx == {
+            "trace_id": header["trace_id"],
+            "parent_span_id": header["parent_span_id"],
+            "request_id": header["request_id"],
+            "clock_offset_ms": header["clock_offset_ms"],
+        }
+        # every admitted request mints its OWN trace, never the
+        # tracer's run-scoped one
+        status, _, _ = gateway.handle_serve(
+            gw.encode_request(_adapt_request(seed=77, deadline_ms=500.0))
+        )
+        assert status == 200
+        roots = [r for r in records if r["name"] == "request"]
+        assert len({r["trace_id"] for r in roots}) == 2
+        assert tracer.trace_id not in {r["trace_id"] for r in roots}
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_micro_batcher_adopts_gateway_trace():
+    """The host-side half of propagation: a request carrying trace_ctx
+    gets its serving root span REPARENTED under the gateway's forward
+    span — same trace id, request_id carried over, the wire-delivered
+    clock_offset_ms stamped as a root attr. A request without trace_ctx
+    keeps a host-local trace (the in-process serving shape)."""
+    tracer, records = _make_tracer(
+        process="host00", span_prefix="host00-"
+    )
+    engine = SimpleNamespace(
+        max_tenants=4,
+        cfg=SimpleNamespace(serving_max_wait_ms=0.0),
+        tracer=tracer,
+        _validate=lambda request: None,
+        _dead=None,
+        warmup_stats={"warmed": True},
+        serve_group=lambda requests, queue_ms=0.0: SimpleNamespace(
+            results=[_FakeResult(r.tenant_id or "t0") for r in requests],
+            bucket=1,
+        ),
+    )
+    batcher = MicroBatcher(engine, max_wait_ms=0.0)
+    try:
+        remote = _adapt_request(tenant_id="edge")
+        remote.trace_ctx = {
+            "trace_id": "feedc0de12345678",
+            "parent_span_id": "gw-s000003",
+            "request_id": "feedc0de12345678-g000001",
+            "clock_offset_ms": -3.25,
+        }
+        local = _adapt_request(seed=9, tenant_id="local")
+        batcher.submit(remote).get(timeout=30)
+        batcher.submit(local).get(timeout=30)
+    finally:
+        batcher.close()
+    roots = {r["attrs"]["tenant_id"]: r for r in records
+             if r["name"] == "request"}
+    adopted = roots["edge"]
+    assert adopted["trace_id"] == "feedc0de12345678"
+    assert adopted["parent_id"] == "gw-s000003"
+    assert adopted["attrs"]["request_id"] == "feedc0de12345678-g000001"
+    assert adopted["attrs"]["clock_offset_ms"] == -3.25
+    assert adopted["span_id"].startswith("host00-")
+    assert adopted["process"] == "host00"
+    own = roots["local"]
+    assert own["trace_id"] != "feedc0de12345678"
+    assert own.get("parent_id") is None
+    assert "clock_offset_ms" not in own["attrs"]
+    # the queue child rides the adopted trace too
+    queues = [r for r in records if r["name"] == "queue"]
+    assert {q["trace_id"] for q in queues} == {
+        adopted["trace_id"], own["trace_id"]
+    }
+
+
+def test_trace_ids_stable_across_hash_seeds():
+    """Propagation is bit-stable across interpreter lifetimes: two
+    fresh processes with different PYTHONHASHSEEDs decode the SAME wire
+    frame through the real host handler and report identical adopted
+    trace context — nothing in the path leans on hash ordering."""
+    req = _adapt_request(tenant_id="tenant-5", deadline_ms=500.0)
+    frame = gw.encode_request(req)
+    header, blob = gw._decode_frame(frame)
+    header.update(
+        priority=0, gateway_elapsed_ms=0.5,
+        trace_id="0123456789abcdef", parent_span_id="gw-s000042",
+        request_id="0123456789abcdef-g000007", clock_offset_ms=-1.75,
+    )
+    fwd_hex = gw._encode_frame(header, [blob]).hex()
+    script = (
+        "from howtotrainyourmamlpytorch_tpu.serving.fleet import (\n"
+        "    FleetHost)\n"
+        "from howtotrainyourmamlpytorch_tpu.serving.gateway import (\n"
+        "    decode_result)\n"
+        "import json\n"
+        "class Pending:\n"
+        "    def __init__(self, request):\n"
+        "        self.request = request\n"
+        "    def get(self, timeout=None):\n"
+        "        import numpy as np\n"
+        "        class R:\n"
+        "            tenant_id = self.request.tenant_id\n"
+        "            preds = np.zeros((6, 5), dtype=np.float32)\n"
+        "            loss = 0.0\n"
+        "            accuracy = 1.0\n"
+        "        return R()\n"
+        "class Router:\n"
+        "    def submit(self, request):\n"
+        "        print(json.dumps(request.trace_ctx, sort_keys=True))\n"
+        "        return Pending(request)\n"
+        "host = FleetHost(Router(), None, host_id='host00')\n"
+        "status, _, body = host.handle_serve(\n"
+        "    bytes.fromhex('%s'))\n"
+        "assert status == 200, (status, body)\n"
+        "print(decode_result(body)['tenant_id'])\n"
+        "host.close()\n"
+    ) % fwd_hex
+    outs = []
+    for seed in ("0", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        outs.append(subprocess.run(
+            [sys.executable, "-c", script], env=env, text=True,
+            capture_output=True, check=True, timeout=120,
+        ).stdout)
+    assert outs[0] == outs[1]
+    ctx = json.loads(outs[0].splitlines()[0])
+    assert ctx == {
+        "trace_id": "0123456789abcdef",
+        "parent_span_id": "gw-s000042",
+        "request_id": "0123456789abcdef-g000007",
+        "clock_offset_ms": -1.75,
+    }
+
+
+# -- keep-alive connection pooling -------------------------------------------
+
+
+def test_forwarder_reuses_pooled_connections():
+    """Sequential forwards to the same host ride ONE kept-alive socket:
+    after the first request primes the pool, reuse dominates, and
+    /stats reports the reuse rate."""
+    gateway, hosts, _ = _make_fleet(n=1)
+    try:
+        for i in range(6):
+            status, _, _ = gateway.handle_serve(
+                gw.encode_request(_adapt_request(seed=i))
+            )
+            assert status == 200
+        assert gateway.pool_fresh >= 1
+        assert gateway.pool_reused >= 4
+        pool = gateway.stats()["conn_pool"]
+        assert pool["reused"] == gateway.pool_reused
+        assert pool["reuse_rate"] == pytest.approx(
+            gateway.pool_reused
+            / (gateway.pool_reused + gateway.pool_fresh),
+            abs=1e-3,
+        )
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+def test_stale_pooled_connection_retries_once_on_fresh_socket():
+    """A broken kept-alive socket is retried ONCE on a guaranteed-fresh
+    connection — invisible to the caller, counted in pool_retries, and
+    never surfaced as a forward failure."""
+    gateway, hosts, _ = _make_fleet(n=1)
+    try:
+        status, _, _ = gateway.handle_serve(
+            gw.encode_request(_adapt_request(seed=0))
+        )
+        assert status == 200
+        # sabotage the pooled socket under the gateway
+        handle = gateway.ring[0]
+        assert handle.pool
+        for conn in handle.pool:
+            if conn.sock is not None:
+                conn.sock.close()
+        status, _, _ = gateway.handle_serve(
+            gw.encode_request(_adapt_request(seed=1))
+        )
+        assert status == 200
+        assert gateway.pool_retries >= 1
+        assert gateway.forward_failures == 0
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+# -- gateway /metrics --------------------------------------------------------
+
+
+def test_gateway_metrics_prometheus_exposition():
+    """The /metrics families parse as text-format 0.0.4 (including the
+    histogram invariants parse_prometheus_text enforces) and agree with
+    the gateway's own counters: typed sheds, per-priority admissions,
+    pool reuse, and the admitted-latency LogHistogram family."""
+    sink = _ListSink()
+    gateway, hosts, _ = _make_fleet(
+        n=1, sink=sink, serving_gateway_queue_budget=1024,
+        serving_gateway_priority_tiers=3,
+    )
+    try:
+        ok = _adapt_request(seed=0, tenant_id="t-ok")
+        ok.priority = 2
+        status, _, _ = gateway.handle_serve(gw.encode_request(ok))
+        assert status == 200
+        # pile up a queue, then ask for the impossible (the
+        # test_gateway.py deadline-shed recipe)
+        h = gateway.ring[0]
+        hosts[h.host_id].pool.replicas[0]._depth = 500
+        gateway.poll_once()
+        doomed = _adapt_request(seed=1, deadline_ms=0.001)
+        status, _, body = gateway.handle_serve(gw.encode_request(doomed))
+        assert status == 429 and json.loads(body)["reason"] == "deadline"
+        metrics = parse_prometheus_text(gateway.render_metrics())
+        assert metrics["gateway_shed_total"]['reason="deadline"'] == 1.0
+        assert metrics["gateway_admitted_total"]['priority="2"'] == 1.0
+        assert metrics["gateway_ready_hosts"][""] == 1.0
+        assert metrics["gateway_conn_pool_fresh_total"][""] >= 1.0
+        assert metrics["gateway_rehomes_total"][""] == 0.0
+        assert metrics["gateway_admitted_latency_ms_count"][""] == 1.0
+        assert metrics["gateway_admitted_latency_ms_sum"][""] > 0.0
+        assert metrics["gateway_admitted_latency_ms_bucket"][
+            'le="+Inf"'] == 1.0
+        # the HTTP route serves the same exposition
+        served = gw.GatewayServer(gateway, port=0)
+        try:
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", served.port, timeout=10
+            )
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+            assert parse_prometheus_text(resp.read().decode()) == metrics
+            conn.close()
+        finally:
+            served.close()
+    finally:
+        _close_fleet(gateway, hosts)
+
+
+# -- clock-aligned merged export ---------------------------------------------
+
+
+def _span(name, cat, trace_id, span_id, start_ms, dur_ms,
+          parent_id=None, process=None, tid="main", **attrs):
+    rec = tel.make_record(
+        "span", name=name, cat=cat, trace_id=trace_id, span_id=span_id,
+        start_ms=start_ms, dur_ms=dur_ms, tid=tid, attrs=attrs,
+    )
+    if parent_id is not None:
+        rec["parent_id"] = parent_id
+    if process is not None:
+        rec["process"] = process
+    return rec
+
+
+def _fleet_span_records(host_skew_ms=4000.0):
+    """A two-process trace: gateway root + forward/wire, host spans on a
+    clock running host_skew_ms AHEAD of the gateway's."""
+    t = "aaaabbbbccccdddd"
+    gwp, hp = "gateway", "host00"
+    sk = host_skew_ms
+    return [
+        _span("request", "gateway", t, "gw-s1", 1000.0, 62.0,
+              process=gwp, request_id="r1"),
+        _span("gateway_queue", "gateway", t, "gw-s2", 1000.0, 2.0,
+              parent_id="gw-s1", process=gwp),
+        _span("forward", "gateway", t, "gw-s3", 1002.0, 59.0,
+              parent_id="gw-s1", process=gwp),
+        _span("wire", "gateway", t, "gw-s4", 1002.5, 58.0,
+              parent_id="gw-s3", process=gwp),
+        _span("request", "serving", t, "host00-s1", 1004.0 + sk, 55.0,
+              parent_id="gw-s3", process=hp, clock_offset_ms=sk),
+        _span("queue", "serving", t, "host00-s2", 1004.0 + sk, 10.0,
+              parent_id="host00-s1", process=hp),
+        _span("assemble", "serving", t, "host00-s3", 1014.0 + sk, 1.0,
+              parent_id="host00-s1", process=hp),
+        _span("dispatch", "serving", t, "host00-s4", 1015.0 + sk, 40.0,
+              parent_id="host00-s1", process=hp),
+        _span("sync", "serving", t, "host00-s5", 1055.0 + sk, 3.0,
+              parent_id="host00-s1", process=hp),
+    ]
+
+
+def test_offset_shift_restores_parent_containment():
+    """The merged export's acceptance geometry: with the Cristian
+    offset applied, every host event lands INSIDE the gateway root's
+    [ts, ts+dur] window on its own process track; without the shift the
+    host track floats seconds away (the shift is load-bearing, not
+    cosmetic)."""
+    spans = _fleet_span_records(host_skew_ms=4000.0)
+    trace = to_chrome_trace(spans, offsets_ms={"host00": 4000.0})
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    by_pid_name = {
+        (e["args"]["span_id"]): e for e in xs
+    }
+    root = by_pid_name["gw-s1"]
+    host_events = [e for e in xs if e["args"]["span_id"].startswith(
+        "host00-")]
+    gw_pid = root["pid"]
+    host_pid = host_events[0]["pid"]
+    assert gw_pid != host_pid
+    for e in host_events:
+        assert e["ts"] >= root["ts"]
+        assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 0.2
+    names = {
+        m["args"]["name"] for m in metas if m["name"] == "process_name"
+    }
+    assert names == {"gateway", "host00"}
+    # timestamps stay monotonic within every (pid, tid) track
+    tracks = {}
+    for e in xs:
+        tracks.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    for ts_list in tracks.values():
+        assert ts_list == sorted(ts_list)
+    # ... and WITHOUT the shift, the host track is 4 seconds adrift
+    unshifted = to_chrome_trace(spans)
+    far = [e for e in unshifted["traceEvents"]
+           if e["ph"] == "X" and e["args"]["span_id"] == "host00-s1"]
+    assert far[0]["ts"] > root["ts"] + root["dur"]
+
+
+def test_fleet_critical_path_attribution():
+    """The six-stage decomposition on a known trace: wire is the socket
+    window NET of the host's request span, device time lands in
+    dispatch, and the complete-trace identity sum(stages) ~= e2e
+    holds."""
+    spans = _fleet_span_records()
+    out = fleet_critical_path(spans)
+    assert out["requests"] == 1 and out["complete"] == 1
+    assert out["spanning_traces"] == 1
+    assert out["processes"] == ["gateway", "host00"]
+    st = out["stages"]
+    assert st["gateway_queue_ms_mean"] == pytest.approx(2.0)
+    assert st["wire_ms_mean"] == pytest.approx(58.0 - 55.0)
+    assert st["host_queue_ms_mean"] == pytest.approx(10.0)
+    assert st["dispatch_ms_mean"] == pytest.approx(40.0)
+    assert out["coverage"] == pytest.approx(
+        out["stage_sum_ms_mean"] / out["e2e_ms_mean"], abs=1e-4
+    )
+    assert 0.9 <= out["coverage"] <= 1.1
+
+
+# -- cli trace --fleet -------------------------------------------------------
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_trace_cli_refuses_multiple_logs_without_fleet(tmp_path, capsys):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_jsonl(a, [])
+    _write_jsonl(b, [])
+    assert trace_cli.main([str(a), str(b)]) == 2
+    assert "--fleet" in capsys.readouterr().err
+
+
+def test_trace_cli_fleet_merges_discovered_host_logs(tmp_path, capsys):
+    """--fleet on the gateway log alone: the log.hostNN.jsonl siblings
+    are auto-discovered (the `cli slo --fleet` rule), host spans are
+    shifted by the gateway's clock records, and one merged Perfetto
+    artifact lands with both process tracks."""
+    spans = _fleet_span_records(host_skew_ms=4000.0)
+    gw_log = tmp_path / "run.jsonl"
+    host_log = tmp_path / "run.host00.jsonl"
+    clock = tel.make_record(
+        "gateway", event="clock", host="host00",
+        clock_offset_ms=4000.0, clock_skew_bound_ms=0.2,
+        rtt_ms=0.4, samples=3,
+    )
+    _write_jsonl(
+        gw_log, [clock] + [r for r in spans if r["process"] == "gateway"]
+    )
+    _write_jsonl(
+        host_log, [r for r in spans if r["process"] == "host00"]
+    )
+    assert trace_cli.main(["--fleet", "--json", str(gw_log)]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["log"] == [str(gw_log), str(host_log)]
+    assert payload["clock_offsets_ms"] == {"host00": 4000.0}
+    assert payload["fleet"]["complete"] == 1
+    out_path = tmp_path / "run.trace.json"
+    assert payload["out"] == str(out_path)
+    trace = json.loads(out_path.read_text())
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len({e["pid"] for e in xs}) == 2
+    root = [e for e in xs if e["args"]["span_id"] == "gw-s1"][0]
+    host_root = [e for e in xs
+                 if e["args"]["span_id"] == "host00-s1"][0]
+    assert root["ts"] <= host_root["ts"]
+    assert host_root["ts"] + host_root["dur"] <= (
+        root["ts"] + root["dur"] + 0.2
+    )

@@ -1077,3 +1077,48 @@ def test_v13_deadline_priority_fields_ride_serving_records():
         "serving", event="deadline", tenant_id="t-7", shots=1,
         deadline_ms=50.0, slack_ms=40.0, missed=False, e2e_ms=10.0,
     ))
+
+
+# -- schema v14: fleet-wide distributed tracing (clock + span process) -------
+
+
+def test_validate_file_accepts_v13_era_fixture():
+    """The pinned v13-era log (gateway shed/rehome/rollup records and
+    prefix-free span ids of the PREVIOUS schema) validates unchanged
+    under v14 — pure addition, nothing tightened."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "telemetry_v13_schema.jsonl"
+    )
+    assert tel.validate_file(fixture) == 8
+
+
+def test_v14_gateway_clock_record_validates():
+    """The gateway kind, event='clock': one Cristian offset sample
+    (offset, RTT/2 skew bound, the RTT it rode) validates and JSON
+    round-trips — the record `cli trace --fleet` reads to shift host
+    spans onto the gateway clock."""
+    rec = tel.make_record(
+        "gateway", event="clock", host="host01",
+        clock_offset_ms=-3.412, clock_skew_bound_ms=0.266,
+        rtt_ms=0.532, samples=4,
+    )
+    assert rec["schema"] == tel.SCHEMA_VERSION
+    tel.validate_record(rec)
+    assert json.loads(json.dumps(rec, allow_nan=False)) == rec
+
+
+def test_v14_span_process_field_validates():
+    """The v14 span addition: an optional top-level `process` label (the
+    per-process track `cli trace --fleet` groups by) — present it
+    validates, absent (every pre-v14 span) nothing is required."""
+    tel.validate_record(tel.make_record(
+        "span", name="request", cat="serving", trace_id="ab12cd34ef567890",
+        span_id="host00-s000001", parent_id="gw-s000003",
+        start_ms=10.0, dur_ms=4.2, tid="serving-batcher",
+        process="host00",
+        attrs={"request_id": "deadbeef-g000001", "clock_offset_ms": -3.4},
+    ))
+    tel.validate_record(tel.make_record(
+        "span", name="request", cat="serving", trace_id="ab12cd34ef567890",
+        span_id="s000001", start_ms=10.0, dur_ms=4.2, tid="main",
+    ))
